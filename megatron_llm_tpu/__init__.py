@@ -1,0 +1,20 @@
+"""megatron_llm_tpu — a TPU-native LLM training framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of the EPFL
+Megatron-LLM fork of Megatron-LM (reference: /root/reference): Llama 1/2,
+CodeLlama, Falcon, GPT with GQA/MQA, RoPE (scaling + theta), RMSNorm,
+flash attention, SwiGLU, untied embeddings, 3D parallelism
+(DP/TP/PP + sequence parallelism) and a ZeRO-1-style distributed optimizer —
+expressed the TPU way: one `jax.sharding.Mesh` over (data, stage, model),
+GSPMD sharding annotations + XLA collectives instead of NCCL call sites,
+`shard_map`+`ppermute` pipelining instead of batched isend/irecv, and Pallas
+kernels for the fused hot ops.
+"""
+
+__version__ = "0.1.0"
+
+from megatron_llm_tpu.config import (  # noqa: F401
+    ModelConfig,
+    ParallelConfig,
+    TrainConfig,
+)

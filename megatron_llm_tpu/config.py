@@ -1,0 +1,408 @@
+"""Typed configuration for megatron_llm_tpu.
+
+Replaces the reference's 1075-line argparse tree (ref: arguments.py:14-345)
+and its global-singleton access pattern (ref: global_vars.py:22-67) with
+plain frozen dataclasses passed explicitly. The flag surface mirrors the
+groups catalogued in SURVEY.md §2.5: network_size, regularization, training,
+initialization, learning-rate, checkpointing, mixed precision, distributed,
+validation, data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# Model architecture
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters (ref: arguments.py:406-474 network_size group)."""
+
+    num_layers: int = 2
+    hidden_size: int = 128
+    ffn_hidden_size: Optional[int] = None  # default 4*h, or derived for GLU presets
+    num_attention_heads: int = 4
+    # GQA/MQA: number of distinct KV heads (ref: arguments.py:420
+    # --num_attention_heads_kv; MQA when 1, GQA when 1<kv<heads).
+    num_attention_heads_kv: Optional[int] = None
+    kv_channels: Optional[int] = None  # head_dim; default hidden/heads
+    max_position_embeddings: int = 2048
+    seq_length: int = 2048
+    padded_vocab_size: int = 0  # set by tokenizer padding (see pad_vocab_size)
+    make_vocab_size_divisible_by: int = 128
+
+    # Norms (ref: arguments.py:434-445, fused_layer_norm.py:64-139)
+    layernorm_epsilon: float = 1e-5
+    use_rms_norm: bool = False
+    use_post_ln: bool = False  # post-LN (BERT-style) vs default pre-LN
+
+    # Projections / activations (ref: arguments.py:439-452)
+    use_bias: bool = True
+    glu_activation: Optional[str] = None  # liglu|geglu|reglu|swiglu
+    hidden_act: str = "gelu"  # used when glu_activation is None
+
+    # Position embeddings (ref: arguments.py:456-463, positional_embeddings.py)
+    position_embedding_type: str = "absolute"  # absolute | rotary
+    rope_scaling_factor: float = 1.0
+    rope_theta: float = 10000.0
+
+    # Falcon-style structure (ref: arguments.py:465-468, transformer.py:774-806)
+    parallel_attn: bool = False  # attention and MLP read the same LN, summed
+    parallel_layernorm: bool = False  # separate LN for MLP input (Falcon-40B)
+
+    # Embedding/head tying (ref: arguments.py:470-473, gpt_model.py:56-78)
+    tie_embed_logits: bool = True
+
+    # Regularization (ref: arguments.py:544-574)
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    lima_dropout: bool = False  # layer-index-scaled dropout (ref: transformer.py:964-971)
+
+    # Precision (ref: arguments.py:783-815)
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    fp32_residual_connection: bool = False
+    apply_query_key_layer_scaling: bool = False
+    attention_softmax_in_fp32: bool = True
+
+    # Init (ref: arguments.py:694-705, layers.py:79-125)
+    init_method_std: float = 0.02
+    use_scaled_init_method: bool = True  # output layers scaled by 1/sqrt(2L)
+
+    # Recompute (ref: arguments.py:606-630)
+    recompute_granularity: Optional[str] = None  # None | "selective" | "full"
+    recompute_method: str = "uniform"
+    recompute_num_layers: int = 1
+
+    # Kernels
+    use_flash_attn: bool = False  # Pallas flash-attention path
+    use_fused_rmsnorm: bool = False  # Pallas fused RMSNorm path
+
+    def __post_init__(self):
+        if self.kv_channels is None:
+            object.__setattr__(
+                self, "kv_channels", self.hidden_size // self.num_attention_heads
+            )
+        if self.num_attention_heads_kv is None:
+            object.__setattr__(self, "num_attention_heads_kv", self.num_attention_heads)
+        if self.ffn_hidden_size is None:
+            object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
+        assert self.num_attention_heads % self.num_attention_heads_kv == 0
+
+    # -- derived ----------------------------------------------------------
+    @property
+    def head_dim(self) -> int:
+        return self.kv_channels
+
+    @property
+    def num_query_groups(self) -> int:
+        return self.num_attention_heads_kv
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_attention_heads // self.num_attention_heads_kv
+
+    @property
+    def qkv_projection_size(self) -> int:
+        # ref: transformer.py:316 — n*hd + 2*n_kv*hd, grouped layout.
+        return self.kv_channels * (
+            self.num_attention_heads + 2 * self.num_attention_heads_kv
+        )
+
+    @property
+    def mlp_input_size(self) -> int:
+        # GLU doubles the up-projection width (ref: transformer.py:92-102).
+        mult = 2 if self.glu_activation else 1
+        return mult * self.ffn_hidden_size
+
+    def pad_vocab_size(self, vocab_size: int, tp: int = 1) -> int:
+        """Pad vocab so it divides evenly over TP ranks (ref: tokenizer.py:49-63)."""
+        multiple = self.make_vocab_size_divisible_by * tp
+        return ((vocab_size + multiple - 1) // multiple) * multiple
+
+
+# ---------------------------------------------------------------------------
+# Parallel layout
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Device-mesh layout (ref: parallel_state.py:51-214, arguments.py:820-866).
+
+    The reference builds NCCL process groups for tp/pp/dp; here the same
+    topology is a single `jax.sharding.Mesh` with axes (data, stage, model)
+    and parallelism is expressed as sharding over those axes.
+    """
+
+    data_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    # Interleaved pipeline: virtual chunks per stage
+    # (ref: --num_layers_per_virtual_pipeline_stage arguments.py:828).
+    virtual_pipeline_parallel_size: Optional[int] = None
+    # Korthikanti sequence parallelism over the model axis
+    # (ref: arguments.py:683; forced off at tp=1 per arguments.py:327-328).
+    sequence_parallel: bool = False
+    # ZeRO-1 optimizer-state sharding over data axis
+    # (ref: --use_distributed_optimizer arguments.py:864).
+    use_distributed_optimizer: bool = False
+    # Number of microbatches for pipelining / gradient accumulation.
+    num_microbatches: int = 1
+
+    def __post_init__(self):
+        if self.tensor_parallel_size == 1 and self.sequence_parallel:
+            object.__setattr__(self, "sequence_parallel", False)
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.data_parallel_size
+            * self.pipeline_parallel_size
+            * self.tensor_parallel_size
+        )
+
+    @property
+    def mesh_shape(self):
+        return (
+            self.data_parallel_size,
+            self.pipeline_parallel_size,
+            self.tensor_parallel_size,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Optimizer / schedule / run-control (ref: arguments.py:579-815)."""
+
+    micro_batch_size: int = 1
+    global_batch_size: int = 1
+    rampup_batch_size: Optional[tuple] = None  # (start, increment, samples)
+
+    train_iters: Optional[int] = None
+    train_samples: Optional[int] = None
+    exit_interval: Optional[int] = None
+    exit_duration_in_mins: Optional[float] = None
+    exit_signal_handler: bool = False
+
+    # Optimizer (ref: arguments.py:666, optimizer/__init__.py:64)
+    optimizer: str = "adam"  # adam | sgd
+    lr: float = 1e-4
+    min_lr: float = 0.0
+    lr_decay_style: str = "linear"  # constant|linear|cosine|inverse-square-root
+    lr_decay_iters: Optional[int] = None
+    lr_decay_samples: Optional[int] = None
+    lr_warmup_iters: int = 0
+    lr_warmup_samples: int = 0
+    lr_warmup_fraction: Optional[float] = None
+    use_checkpoint_opt_param_scheduler: bool = False
+    override_opt_param_scheduler: bool = False
+
+    weight_decay: float = 0.01
+    start_weight_decay: Optional[float] = None
+    end_weight_decay: Optional[float] = None
+    weight_decay_incr_style: str = "constant"  # constant|linear|cosine
+    clip_grad: float = 1.0
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    sgd_momentum: float = 0.9
+
+    # Mixed precision (ref: arguments.py:783-815)
+    fp16: bool = False
+    bf16: bool = True
+    loss_scale: Optional[float] = None  # constant scale; None => dynamic if fp16
+    initial_loss_scale: float = 2.0**32
+    min_loss_scale: float = 1.0
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+
+    # Checkpointing (ref: arguments.py:751-779)
+    save: Optional[str] = None
+    load: Optional[str] = None
+    save_interval: Optional[int] = None
+    finetune: bool = False
+    no_load_optim: bool = False
+    no_load_rng: bool = False
+
+    # Logging / eval (ref: arguments.py:477-541, 870-877)
+    log_interval: int = 100
+    eval_interval: int = 1000
+    eval_iters: int = 100
+    tensorboard_dir: Optional[str] = None
+    wandb_logger: bool = False
+
+    seed: int = 1234
+
+    def __post_init__(self):
+        assert not (self.fp16 and self.bf16)
+        if self.train_iters is not None and self.train_samples is not None:
+            raise ValueError("specify train_iters or train_samples, not both")
+
+
+# ---------------------------------------------------------------------------
+# Model family presets (ref: llama_model.py:22-30, falcon_model.py:18-29,
+# examples/finetune.sh:62-109)
+# ---------------------------------------------------------------------------
+
+_LLAMA_SIZES = {
+    # size -> (layers, hidden, heads, n_kv, ffn)
+    7: (32, 4096, 32, 32, 11008),
+    13: (40, 5120, 40, 40, 13824),
+    30: (60, 6656, 52, 52, 17920),
+    34: (48, 8192, 64, 8, 22016),  # CodeLlama-34B (GQA)
+    65: (80, 8192, 64, 64, 22016),
+    70: (80, 8192, 64, 8, 28672),  # Llama-2-70B (GQA)
+}
+
+_FALCON_SIZES = {
+    # size -> (layers, hidden, heads, n_kv, parallel_layernorm)
+    7: (32, 4544, 71, 1, False),
+    40: (60, 8192, 128, 8, True),
+}
+
+
+def llama_config(
+    size_b: int = 7,
+    version: int = 2,
+    seq_length: int = 4096,
+    vocab_size: int = 32000,
+    tp: int = 1,
+    **overrides,
+) -> ModelConfig:
+    """Llama-1/2/CodeLlama preset (ref: llama_model.py:10-44).
+
+    Asserts mirrored from the reference: rotary + swiglu + RMSNorm + no bias
+    + untied embeddings (ref: llama_model.py:22-30).
+    """
+    layers, hidden, heads, n_kv, ffn = _LLAMA_SIZES[size_b]
+    if version == 1:
+        seq_length = min(seq_length, 2048)
+    cfg = dict(
+        num_layers=layers,
+        hidden_size=hidden,
+        num_attention_heads=heads,
+        num_attention_heads_kv=n_kv,
+        ffn_hidden_size=ffn,
+        seq_length=seq_length,
+        max_position_embeddings=seq_length,
+        position_embedding_type="rotary",
+        glu_activation="swiglu",
+        use_rms_norm=True,
+        use_bias=False,
+        tie_embed_logits=False,
+        layernorm_epsilon=1e-6 if version == 1 else 1e-5,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        init_method_std=0.02,
+    )
+    cfg.update(overrides)
+    mc = ModelConfig(**cfg)
+    if mc.padded_vocab_size == 0:
+        mc = dataclasses.replace(mc, padded_vocab_size=mc.pad_vocab_size(vocab_size, tp))
+    return mc
+
+
+def codellama_config(size_b: int = 7, seq_length: int = 16384, **overrides) -> ModelConfig:
+    """CodeLlama: Llama-2 + rope_theta=1e6 + 16k seq (ref: examples/finetune.sh:74-86)."""
+    overrides.setdefault("rope_theta", 1e6)
+    return llama_config(size_b, version=2, seq_length=seq_length,
+                        vocab_size=overrides.pop("vocab_size", 32016), **overrides)
+
+
+def falcon_config(
+    size_b: int = 7,
+    seq_length: int = 2048,
+    vocab_size: int = 65024,
+    tp: int = 1,
+    **overrides,
+) -> ModelConfig:
+    """Falcon preset (ref: falcon_model.py:10-42): rotary + MQA/GQA +
+    parallel attention; 40B adds parallel layernorm."""
+    layers, hidden, heads, n_kv, pln = _FALCON_SIZES[size_b]
+    cfg = dict(
+        num_layers=layers,
+        hidden_size=hidden,
+        num_attention_heads=heads,
+        num_attention_heads_kv=n_kv,
+        ffn_hidden_size=4 * hidden,
+        seq_length=seq_length,
+        max_position_embeddings=seq_length,
+        position_embedding_type="rotary",
+        glu_activation=None,
+        hidden_act="gelu",
+        use_rms_norm=False,
+        use_bias=False,
+        parallel_attn=True,
+        parallel_layernorm=pln,
+        tie_embed_logits=True,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    cfg.update(overrides)
+    mc = ModelConfig(**cfg)
+    if mc.padded_vocab_size == 0:
+        mc = dataclasses.replace(mc, padded_vocab_size=mc.pad_vocab_size(vocab_size, tp))
+    return mc
+
+
+def gpt_config(
+    num_layers: int = 12,
+    hidden_size: int = 768,
+    num_attention_heads: int = 12,
+    seq_length: int = 1024,
+    vocab_size: int = 50257,
+    tp: int = 1,
+    **overrides,
+) -> ModelConfig:
+    """GPT-2/3-style preset (ref: gpt_model.py:45)."""
+    cfg = dict(
+        num_layers=num_layers,
+        hidden_size=hidden_size,
+        num_attention_heads=num_attention_heads,
+        seq_length=seq_length,
+        max_position_embeddings=seq_length,
+        position_embedding_type="absolute",
+        hidden_act="gelu",
+        tie_embed_logits=True,
+    )
+    cfg.update(overrides)
+    mc = ModelConfig(**cfg)
+    if mc.padded_vocab_size == 0:
+        mc = dataclasses.replace(mc, padded_vocab_size=mc.pad_vocab_size(vocab_size, tp))
+    return mc
+
+
+def tiny_config(**overrides) -> ModelConfig:
+    """Small config for tests."""
+    cfg = dict(
+        num_layers=2,
+        hidden_size=64,
+        num_attention_heads=4,
+        num_attention_heads_kv=2,
+        ffn_hidden_size=128,
+        seq_length=64,
+        max_position_embeddings=64,
+        padded_vocab_size=256,
+        position_embedding_type="rotary",
+        glu_activation="swiglu",
+        use_rms_norm=True,
+        use_bias=False,
+        tie_embed_logits=False,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    cfg.update(overrides)
+    return ModelConfig(**cfg)

@@ -1,0 +1,3 @@
+from megatron_llm_tpu.models.gpt import GPTModel  # noqa: F401
+from megatron_llm_tpu.models.llama import LlamaModel  # noqa: F401
+from megatron_llm_tpu.models.falcon import FalconModel  # noqa: F401

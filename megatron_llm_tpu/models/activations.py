@@ -1,0 +1,57 @@
+"""GLU activation zoo (ref: megatron/model/glu_activations.py:24-55).
+
+Each GLU splits the doubled up-projection in half along the last dim and
+gates: act(x1) * x2. The registry mirrors the reference's
+`GLU_ACTIVATIONS` dict (ref: glu_activations.py:50-55).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _split(x: jnp.ndarray):
+    return jnp.split(x, 2, axis=-1)
+
+
+def liglu(x):
+    a, b = _split(x)
+    return a * b
+
+
+def geglu(x):
+    a, b = _split(x)
+    return jax.nn.gelu(a, approximate=False) * b
+
+
+def reglu(x):
+    a, b = _split(x)
+    return jax.nn.relu(a) * b
+
+
+def swiglu(x):
+    a, b = _split(x)
+    return jax.nn.silu(a) * b
+
+
+GLU_ACTIVATIONS = {
+    "liglu": liglu,
+    "geglu": geglu,
+    "reglu": reglu,
+    "swiglu": swiglu,
+}
+
+ACTIVATIONS = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+def mlp_activation(cfg):
+    """Resolve the MLP activation from config (GLU takes precedence)."""
+    if cfg.glu_activation is not None:
+        return GLU_ACTIVATIONS[cfg.glu_activation]
+    return ACTIVATIONS[cfg.hidden_act]

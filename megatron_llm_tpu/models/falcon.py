@@ -1,0 +1,20 @@
+"""Falcon 7B/40B (ref: megatron/model/falcon_model.py:10-42)."""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.models.gpt import GPTModel
+
+
+class FalconModel(GPTModel):
+    """Asserts the Falcon architectural invariants the reference enforces
+    (ref: falcon_model.py:18-29): rotary + MQA/GQA + parallel attention;
+    parallel_layernorm distinguishes 40B from 7B."""
+
+    def _check_config(self):
+        cfg = self.cfg
+        assert cfg.position_embedding_type == "rotary", "falcon requires RoPE"
+        assert cfg.parallel_attn, "falcon uses parallel attention"
+        assert cfg.num_attention_heads_kv < cfg.num_attention_heads, (
+            "falcon uses MQA/GQA"
+        )
+        assert not cfg.use_post_ln

@@ -1,0 +1,84 @@
+"""GPT-family model wrapper (ref: megatron/model/gpt_model.py).
+
+A thin stateless class: holds the config, exposes `init` / `forward` /
+`loss`. All state lives in the params pytree so the whole object is safe to
+close over in jitted functions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import ModelConfig
+from megatron_llm_tpu.models.language_model import (
+    init_language_model_params,
+    language_model_forward,
+)
+from megatron_llm_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+
+
+class GPTModel:
+    """ref: GPTModel gpt_model.py:45-124."""
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+        self._check_config()
+
+    def _check_config(self):
+        pass
+
+    def init(self, rng: jax.Array) -> dict:
+        return init_language_model_params(self.cfg, rng)
+
+    def forward(
+        self,
+        params: dict,
+        tokens: jnp.ndarray,
+        position_ids: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        dropout_rng=None,
+        deterministic: bool = True,
+        kv_caches: Optional[dict] = None,
+    ) -> Tuple[jnp.ndarray, Optional[dict]]:
+        """Returns (logits, new_kv_caches) (ref: gpt_model.py:84-100)."""
+        return language_model_forward(
+            params, self.cfg, tokens, position_ids, attention_mask,
+            dropout_rng, deterministic, kv_caches,
+        )
+
+    def loss(
+        self,
+        params: dict,
+        tokens: jnp.ndarray,
+        labels: jnp.ndarray,
+        loss_mask: Optional[jnp.ndarray] = None,
+        position_ids: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        dropout_rng=None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        """Mean masked CE (ref: post_language_model_processing
+        gpt_model.py:18-42 + loss_func finetune.py:83-89)."""
+        logits, _ = self.forward(
+            params, tokens, position_ids, attention_mask, dropout_rng, deterministic
+        )
+        losses = vocab_parallel_cross_entropy(logits, labels)
+        if loss_mask is None:
+            return jnp.mean(losses)
+        loss_mask = loss_mask.astype(jnp.float32)
+        return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+
+    def init_kv_caches(self, batch_size: int, max_len: int) -> dict:
+        """Per-layer stacked KV cache for incremental decode
+        (ref: InferenceParams forward_step.py:17-41)."""
+        cfg = self.cfg
+        shape = (cfg.num_layers, batch_size, max_len, cfg.num_query_groups,
+                 cfg.head_dim)
+        return {
+            "k": jnp.zeros(shape, cfg.compute_dtype),
+            "v": jnp.zeros(shape, cfg.compute_dtype),
+            "offset": jnp.array(0, jnp.int32),
+        }

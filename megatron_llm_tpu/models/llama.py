@@ -1,0 +1,20 @@
+"""Llama 1/2 + CodeLlama (ref: megatron/model/llama_model.py:10-44)."""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.models.gpt import GPTModel
+
+
+class LlamaModel(GPTModel):
+    """Asserts the Llama architectural invariants the reference enforces
+    (ref: llama_model.py:22-30)."""
+
+    def _check_config(self):
+        cfg = self.cfg
+        assert cfg.position_embedding_type == "rotary", "llama requires RoPE"
+        assert cfg.glu_activation == "swiglu", "llama requires SwiGLU"
+        assert cfg.use_rms_norm, "llama requires RMSNorm"
+        assert not cfg.use_bias, "llama uses no bias"
+        assert not cfg.use_post_ln, "llama is pre-LN"
+        assert not cfg.tie_embed_logits, "llama has untied embeddings"
+        assert not cfg.parallel_attn

@@ -1,0 +1,232 @@
+"""Decoder transformer stack — scan-over-layers, remat-aware.
+
+Parity target: ref megatron/model/transformer.py (`ParallelMLP` :77,
+`ParallelTransformerLayer` :582, `ParallelTransformer` :897). TPU-first
+departures:
+
+- Layer weights are *stacked* along a leading layer axis and the stack is a
+  single `jax.lax.scan`, so the whole model compiles once regardless of
+  depth (the reference's Python per-layer loop, transformer.py:1236-1242,
+  is a CUDA-graph idiom XLA doesn't need).
+- Activation recompute is `jax.checkpoint` on the scanned body
+  (ref: recompute_granularity arguments.py:606-630, random.py:175-247).
+- Residual structure covers pre/post-LN, Falcon parallel-attention and
+  parallel-layernorm variants (ref: transformer.py:613-634, 774-806).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.models.activations import mlp_activation
+from megatron_llm_tpu.models.attention import attention_block
+from megatron_llm_tpu.models.norms import apply_norm
+from megatron_llm_tpu.parallel.mesh import shard_activation
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _normal(key, shape, std, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+def init_norm_params(cfg, shape_prefix=()) -> dict:
+    p = {"scale": jnp.ones(shape_prefix + (cfg.hidden_size,), cfg.params_dtype)}
+    if not cfg.use_rms_norm:
+        p["bias"] = jnp.zeros(shape_prefix + (cfg.hidden_size,), cfg.params_dtype)
+    return p
+
+
+def init_layer_params(cfg, key, num_layers: Optional[int] = None) -> dict:
+    """Stacked per-layer weights, leading axis = layer.
+
+    Init distributions follow the reference (ref: model/utils.py:11-24,
+    layers.py:79-125): normal(0, std) for inputs projections, and
+    normal(0, std/sqrt(2*num_layers)) for the residual-output projections
+    (wo, w2) when use_scaled_init_method.
+    """
+    L = num_layers if num_layers is not None else cfg.num_layers
+    h = cfg.hidden_size
+    std = cfg.init_method_std
+    out_std = std / jnp.sqrt(2.0 * cfg.num_layers) if cfg.use_scaled_init_method else std
+    keys = jax.random.split(key, 4)
+    dt = cfg.params_dtype
+
+    attn = {
+        "wqkv": _normal(keys[0], (L, h, cfg.qkv_projection_size), std, dt),
+        "wo": _normal(
+            keys[1],
+            (L, cfg.num_attention_heads * cfg.head_dim, h),
+            out_std,
+            dt,
+        ),
+    }
+    mlp = {
+        "w1": _normal(keys[2], (L, h, cfg.mlp_input_size), std, dt),
+        "w2": _normal(keys[3], (L, cfg.ffn_hidden_size, h), out_std, dt),
+    }
+    if cfg.use_bias:
+        attn["bqkv"] = jnp.zeros((L, cfg.qkv_projection_size), dt)
+        attn["bo"] = jnp.zeros((L, h), dt)
+        mlp["b1"] = jnp.zeros((L, cfg.mlp_input_size), dt)
+        mlp["b2"] = jnp.zeros((L, h), dt)
+
+    layers = {
+        "input_norm": init_norm_params(cfg, (L,)),
+        "attention": attn,
+        "mlp": mlp,
+    }
+    # post-attention norm exists unless Falcon-style parallel_attn without
+    # a dedicated mlp norm (ref: transformer.py:613-634).
+    if not cfg.parallel_attn:
+        layers["post_attention_norm"] = init_norm_params(cfg, (L,))
+    if cfg.parallel_layernorm:
+        layers["mlp_norm"] = init_norm_params(cfg, (L,))
+    return layers
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def mlp_block(mlp_params, cfg, hidden, dropout_rng, deterministic):
+    """ParallelMLP (ref: transformer.py:77-142): h -> [2*]ffn -> act -> h."""
+    dt = cfg.compute_dtype
+    x = hidden @ mlp_params["w1"].astype(dt)
+    if "b1" in mlp_params:
+        x = x + mlp_params["b1"].astype(dt)
+    x = shard_activation(x, "ffn")
+    x = mlp_activation(cfg)(x)
+    x = x @ mlp_params["w2"].astype(dt)
+    if "b2" in mlp_params:
+        x = x + mlp_params["b2"].astype(dt)
+    return x
+
+
+def _dropout(x, rate, rng, deterministic):
+    if deterministic or rate == 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return x * keep / (1.0 - rate)
+
+
+def transformer_layer(
+    layer_params: dict,
+    cfg,
+    hidden: jnp.ndarray,
+    rope_table,
+    mask,
+    position_ids,
+    dropout_rng=None,
+    deterministic: bool = True,
+    kv_cache: Optional[dict] = None,
+    hidden_dropout_rate: Optional[float] = None,
+) -> Tuple[jnp.ndarray, Optional[dict]]:
+    """One decoder layer (ref: ParallelTransformerLayer.forward
+    transformer.py:695-817), covering:
+
+    - pre-LN (default) / post-LN (cfg.use_post_ln, ref :630-634)
+    - Falcon parallel attention: mlp input = same norm output, residual =
+      hidden + attn_out + mlp_out (ref :774-806)
+    - Falcon-40B parallel layernorm: dedicated mlp_norm (ref :613-629)
+    """
+    p_hidden = cfg.hidden_dropout if hidden_dropout_rate is None else hidden_dropout_rate
+    if dropout_rng is not None:
+        attn_rng, h1_rng, h2_rng = jax.random.split(dropout_rng, 3)
+    else:
+        attn_rng = h1_rng = h2_rng = None
+
+    residual = hidden
+    normed = apply_norm(hidden, layer_params["input_norm"], cfg)
+    attn_out, new_cache = attention_block(
+        layer_params["attention"], cfg, normed, rope_table, mask, position_ids,
+        attn_rng, deterministic, kv_cache,
+    )
+
+    if cfg.parallel_attn:
+        if cfg.parallel_layernorm:
+            mlp_in = apply_norm(hidden, layer_params["mlp_norm"], cfg)
+        else:
+            mlp_in = normed
+        mlp_out = mlp_block(layer_params["mlp"], cfg, mlp_in, h2_rng, deterministic)
+        out = residual + _dropout(attn_out + mlp_out, p_hidden, h1_rng, deterministic)
+    elif cfg.use_post_ln:
+        x = residual + _dropout(attn_out, p_hidden, h1_rng, deterministic)
+        x = apply_norm(x, layer_params["post_attention_norm"], cfg)
+        mlp_out = mlp_block(layer_params["mlp"], cfg, x, h2_rng, deterministic)
+        out = x + _dropout(mlp_out, p_hidden, h2_rng, deterministic)
+        # final norm handled by caller; post-LN applies input_norm after attn
+    else:
+        x = residual + _dropout(attn_out, p_hidden, h1_rng, deterministic)
+        normed2 = apply_norm(x, layer_params["post_attention_norm"], cfg)
+        mlp_out = mlp_block(layer_params["mlp"], cfg, normed2, h2_rng, deterministic)
+        out = x + _dropout(mlp_out, p_hidden, h2_rng, deterministic)
+
+    out = shard_activation(out, "hidden")
+    return out, new_cache
+
+
+def transformer_stack(
+    layer_params: dict,
+    cfg,
+    hidden: jnp.ndarray,
+    rope_table=None,
+    mask=None,
+    position_ids=None,
+    dropout_rng=None,
+    deterministic: bool = True,
+    kv_caches: Optional[dict] = None,
+    layer_offset: int = 0,
+) -> Tuple[jnp.ndarray, Optional[dict]]:
+    """Scan the stacked layers (ref: ParallelTransformer.forward
+    transformer.py:1158-1246).
+
+    `kv_caches` = {"k": (L,b,T,g,d), "v": ..., "offset": scalar} or None.
+    `layer_offset` supports pipeline chunks (ref vpp offset math
+    transformer.py:1015-1045): layer i's dropout key and LIMA rate use
+    global index layer_offset + i.
+    """
+    L = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+    num_total = cfg.num_layers
+
+    def body(carry, xs):
+        hidden, = carry
+        params_l, idx, cache_l = xs
+        if dropout_rng is not None:
+            rng_l = jax.random.fold_in(dropout_rng, idx)
+        else:
+            rng_l = None
+        if cfg.lima_dropout and num_total > 1:
+            # linear ramp 0 -> hidden_dropout over depth (ref: transformer.py:964-971)
+            p_l = cfg.hidden_dropout * idx.astype(jnp.float32) / (num_total - 1)
+        else:
+            p_l = None
+        out, new_cache_l = transformer_layer(
+            params_l, cfg, hidden, rope_table, mask, position_ids,
+            rng_l, deterministic, cache_l, hidden_dropout_rate=p_l,
+        )
+        return (out,), new_cache_l
+
+    if cfg.recompute_granularity == "full":
+        body = jax.checkpoint(body, prevent_cse=False)
+
+    idxs = layer_offset + jnp.arange(L)
+    if kv_caches is not None:
+        xs = (layer_params, idxs, {"k": kv_caches["k"], "v": kv_caches["v"],
+                                   "offset": jnp.broadcast_to(kv_caches["offset"], (L,))})
+        (hidden,), caches_out = jax.lax.scan(body, (hidden,), xs)
+        new_caches = {"k": caches_out["k"], "v": caches_out["v"],
+                      "offset": kv_caches["offset"] + hidden.shape[1]}
+    else:
+        xs = (layer_params, idxs, None)
+        (hidden,), _ = jax.lax.scan(body, (hidden,), xs)
+        new_caches = None
+    return hidden, new_caches

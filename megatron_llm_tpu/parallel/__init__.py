@@ -1,0 +1,11 @@
+from megatron_llm_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    STAGE_AXIS,
+    ParallelContext,
+    build_mesh,
+    get_context,
+    initialize_parallel,
+    shard_activation,
+    use_mesh,
+)

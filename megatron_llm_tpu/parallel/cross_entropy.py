@@ -1,0 +1,100 @@
+"""Vocab-parallel cross entropy.
+
+Parity target: ref megatron/core/tensor_parallel/cross_entropy.py:14-143 —
+the reference hand-writes allreduce(max), masked target-logit gather,
+allreduce(sum_exp) and a custom backward. On TPU the same dataflow is
+expressed once in jnp: with logits sharded over the model axis on the vocab
+dim, XLA's GSPMD lowers the max/sum reductions to psum over ICI and AD
+derives the backward. An explicit `shard_map` variant is provided for when
+manual control is wanted; both match the reference's math including
+label smoothing (ref :71-87).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_llm_tpu.parallel.mesh import MODEL_AXIS, get_context
+
+
+def cross_entropy(
+    logits: jnp.ndarray,  # (..., vocab), any float dtype
+    targets: jnp.ndarray,  # (...), int
+    label_smoothing: float = 0.0,
+) -> jnp.ndarray:
+    """Per-token CE loss in fp32 (ref: _VocabParallelCrossEntropy.forward)."""
+    logits = logits.astype(jnp.float32)
+    logits_max = jnp.max(logits, axis=-1, keepdims=True)
+    shifted = logits - jax.lax.stop_gradient(logits_max)
+    sum_exp = jnp.sum(jnp.exp(shifted), axis=-1)
+    log_z = jnp.log(sum_exp)
+    target_logit = jnp.take_along_axis(
+        shifted, targets[..., None], axis=-1
+    ).squeeze(-1)
+    loss = log_z - target_logit
+    if label_smoothing > 0.0:
+        # ref :71-87: smoothed loss mixes in mean log-prob over the vocab
+        vocab = logits.shape[-1]
+        smoothing = label_smoothing * vocab / (vocab - 1)
+        mean_log_prob = jnp.mean(shifted, axis=-1) - log_z
+        loss = (1.0 - smoothing) * loss - smoothing * mean_log_prob
+    return loss
+
+
+def _ce_shard(logits, targets, vocab_per_shard, label_smoothing):
+    """Per-shard body: local max/sum-exp + masked target gather, psum'd
+    (mirrors ref cross_entropy.py:20-95 collective-for-collective)."""
+    rank = jax.lax.axis_index(MODEL_AXIS)
+    logits = logits.astype(jnp.float32)
+    local_max = jnp.max(logits, axis=-1)
+    global_max = jax.lax.pmax(local_max, MODEL_AXIS)
+    shifted = logits - global_max[..., None]
+    exp = jnp.exp(shifted)
+    sum_exp = jax.lax.psum(jnp.sum(exp, axis=-1), MODEL_AXIS)
+    log_z = jnp.log(sum_exp)
+
+    vocab_start = rank * vocab_per_shard
+    local_target = targets - vocab_start
+    in_range = (local_target >= 0) & (local_target < vocab_per_shard)
+    safe_target = jnp.where(in_range, local_target, 0)
+    gathered = jnp.take_along_axis(shifted, safe_target[..., None], axis=-1).squeeze(-1)
+    target_logit = jax.lax.psum(jnp.where(in_range, gathered, 0.0), MODEL_AXIS)
+
+    loss = log_z - target_logit
+    if label_smoothing > 0.0:
+        vocab = vocab_per_shard * jax.lax.psum(1, MODEL_AXIS)
+        smoothing = label_smoothing * vocab / (vocab - 1)
+        sum_log_prob = jax.lax.psum(jnp.sum(shifted, axis=-1), MODEL_AXIS)
+        mean_log_prob = sum_log_prob / vocab - log_z
+        loss = (1.0 - smoothing) * loss - smoothing * mean_log_prob
+    return loss
+
+
+def vocab_parallel_cross_entropy(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    label_smoothing: float = 0.0,
+    explicit: bool = False,
+) -> jnp.ndarray:
+    """CE over vocab-sharded logits.
+
+    Default path: plain jnp under GSPMD (XLA inserts the psums). With
+    `explicit=True` and an installed mesh, runs the hand-written shard_map
+    version (useful for verifying collective placement)."""
+    ctx = get_context()
+    if not explicit or ctx is None or ctx.tp == 1:
+        return cross_entropy(logits, targets, label_smoothing)
+    vocab_per_shard = logits.shape[-1] // ctx.tp
+    fn = jax.shard_map(
+        partial(_ce_shard, vocab_per_shard=vocab_per_shard,
+                label_smoothing=label_smoothing),
+        mesh=ctx.mesh,
+        in_specs=(P("data", None, MODEL_AXIS), P("data", None)),
+        out_specs=P("data", None),
+    )
+    return fn(logits, targets)

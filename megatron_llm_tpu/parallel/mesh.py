@@ -1,0 +1,150 @@
+"""Device-mesh topology — the TPU-native replacement for the reference's
+process-group "mpu" layer (ref: megatron/core/parallel_state.py:51-524).
+
+Where the reference builds NCCL process groups per (tp, pp, dp) coordinate
+and offers ~40 rank/size getters, on TPU a single `jax.sharding.Mesh` with
+named axes ("data", "stage", "model") carries the whole topology: TP/SP is
+sharding over "model", PP over "stage", DP over "data". XLA's GSPMD inserts
+the collectives the reference issues by hand.
+
+The rank-order convention matches the reference so multi-host layouts map
+the same way: tp is innermost (fastest-varying), then pp, then dp
+(ref: parallel_state.py:88-130 builds dp groups with stride tp*pp).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+STAGE_AXIS = "stage"
+MODEL_AXIS = "model"
+AXIS_NAMES = (DATA_AXIS, STAGE_AXIS, MODEL_AXIS)
+
+_CONTEXT: Optional["ParallelContext"] = None
+
+
+def build_mesh(
+    dp: int = 1,
+    pp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (data, stage, model) mesh.
+
+    Axis order puts `model` innermost so TP collectives ride the
+    fastest ICI links (analogue of the reference keeping TP within a node,
+    ref: docs/guide/faq.md policy "TP <= GPUs/node").
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = dp * pp * tp
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for dp={dp} pp={pp} tp={tp}, have {len(devices)}")
+    dev_array = np.asarray(devices[:n]).reshape(dp, pp, tp)
+    return Mesh(dev_array, AXIS_NAMES)
+
+
+@dataclass
+class ParallelContext:
+    """Holds the mesh + parallel flags; the analogue of the reference's
+    module-global parallel state (ref: parallel_state.py:20-49)."""
+
+    mesh: Mesh
+    sequence_parallel: bool = False
+
+    # -- size getters (ref: parallel_state.py:327-372) --------------------
+    @property
+    def dp(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def pp(self) -> int:
+        return self.mesh.shape[STAGE_AXIS]
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape[MODEL_AXIS]
+
+    @property
+    def world_size(self) -> int:
+        return self.dp * self.pp * self.tp
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+
+def initialize_parallel(
+    dp: int = 1, pp: int = 1, tp: int = 1, sequence_parallel: bool = False,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> ParallelContext:
+    """Create and install the global context (ref analogue:
+    initialize_model_parallel, parallel_state.py:51)."""
+    global _CONTEXT
+    mesh = build_mesh(dp, pp, tp, devices)
+    _CONTEXT = ParallelContext(mesh=mesh, sequence_parallel=sequence_parallel)
+    return _CONTEXT
+
+
+def get_context() -> Optional[ParallelContext]:
+    return _CONTEXT
+
+
+def destroy_parallel() -> None:
+    """Ref analogue: destroy_model_parallel (parallel_state.py:497)."""
+    global _CONTEXT
+    _CONTEXT = None
+
+
+@contextlib.contextmanager
+def use_mesh(ctx: ParallelContext):
+    """Temporarily install a context (tests use this to swap meshes)."""
+    global _CONTEXT
+    prev = _CONTEXT
+    _CONTEXT = ctx
+    try:
+        yield ctx
+    finally:
+        _CONTEXT = prev
+
+
+# ---------------------------------------------------------------------------
+# Activation sharding constraints
+# ---------------------------------------------------------------------------
+# Model code calls `shard_activation(x, kind)` at the few load-bearing points;
+# when no mesh is installed these are no-ops, so single-device code paths are
+# identical. GSPMD propagates everything else.
+
+_ACTIVATION_SPECS = {
+    # (batch, seq, hidden) residual stream
+    "hidden": P(DATA_AXIS, None, None),
+    # (batch, seq, hidden) in the norm/dropout regions under sequence
+    # parallelism — seq dim sharded over the model axis
+    # (ref: mappings.py:191-246 scatter/gather_to_sequence_parallel_region)
+    "hidden_seq": P(DATA_AXIS, MODEL_AXIS, None),
+    # (batch, seq, heads, head_dim) — heads over model axis (TP attention)
+    "heads": P(DATA_AXIS, None, MODEL_AXIS, None),
+    # (batch, seq, kv_heads, q_per_kv, head_dim) grouped GQA layout
+    "groups": P(DATA_AXIS, None, MODEL_AXIS, None, None),
+    # (batch, seq, ffn) MLP intermediate — ffn over model axis
+    "ffn": P(DATA_AXIS, None, MODEL_AXIS),
+    # (batch, seq, vocab) logits — vocab-parallel
+    # (ref: layers.py:128-210 VocabParallelEmbedding / parallel_lm_logits)
+    "logits": P(DATA_AXIS, None, MODEL_AXIS),
+}
+
+
+def shard_activation(x, kind: str):
+    ctx = _CONTEXT
+    if ctx is None:
+        return x
+    spec = _ACTIVATION_SPECS[kind]
+    if kind == "hidden_seq" and not ctx.sequence_parallel:
+        spec = _ACTIVATION_SPECS["hidden"]
+    return jax.lax.with_sharding_constraint(x, NamedSharding(ctx.mesh, spec))

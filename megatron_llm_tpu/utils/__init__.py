@@ -1,0 +1,1 @@
+from megatron_llm_tpu.utils.masks import get_ltor_masks_and_position_ids  # noqa: F401

@@ -1,0 +1,58 @@
+"""Mask/position-id construction (ref: megatron/utils.py:137-196
+`get_ltor_masks_and_position_ids`)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def get_ltor_masks_and_position_ids(
+    tokens: jnp.ndarray,  # (b, s) int
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+) -> Tuple[Optional[jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """Returns (attention_mask, loss_mask, position_ids).
+
+    attention_mask is (b, 1, s, s) boolean, True = masked out, or None when
+    plain causal (so the flash path can be taken). EOD-reset variants are
+    built vectorised (the reference loops over batch in Python,
+    ref: utils.py:162-191); document boundaries are where tokens == eod.
+    """
+    b, s = tokens.shape
+    rows = jnp.arange(s)[:, None]
+    cols = jnp.arange(s)[None, :]
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(tokens == eod_token, 0.0, loss_mask)
+
+    if not (reset_position_ids or reset_attention_mask):
+        position_ids = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        return None, loss_mask, position_ids
+
+    assert eod_token is not None
+    is_eod = (tokens == eod_token).astype(jnp.int32)  # (b, s)
+    # doc_id[t] = number of EODs strictly before t
+    doc_id = jnp.cumsum(is_eod, axis=1) - is_eod  # eod token belongs to its doc
+
+    if reset_position_ids:
+        # position within current document: t - index_of_last_boundary
+        idx = jnp.arange(s)[None, :]
+        # boundary position b_t = largest j <= t with eod at j-1 (or 0)
+        boundary = jnp.where(jnp.pad(is_eod[:, :-1], ((0, 0), (1, 0))) == 1, idx, 0)
+        start = jax.lax.cummax(boundary, axis=1)
+        position_ids = idx - start
+    else:
+        position_ids = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+
+    causal = cols > rows  # (s, s), True = masked
+    if reset_attention_mask:
+        same_doc = doc_id[:, :, None] == doc_id[:, None, :]  # (b, s, s)
+        mask = (~same_doc) | causal[None]
+    else:
+        mask = jnp.broadcast_to(causal[None], (b, s, s))
+    return mask[:, None], loss_mask, position_ids

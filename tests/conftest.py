@@ -1,0 +1,43 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference cannot test collectives without >=2 real GPUs
+(SURVEY.md §4); on JAX we force 8 host-platform devices so TP/PP/DP tests
+run anywhere. Must set env vars before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    """2x2x2 (data, stage, model) mesh on 8 CPU devices."""
+    from megatron_llm_tpu.parallel import initialize_parallel
+    from megatron_llm_tpu.parallel.mesh import destroy_parallel
+
+    ctx = initialize_parallel(dp=2, pp=2, tp=2)
+    yield ctx
+    destroy_parallel()
+
+
+@pytest.fixture
+def tp8():
+    """Pure tensor-parallel mesh tp=8."""
+    from megatron_llm_tpu.parallel import initialize_parallel
+    from megatron_llm_tpu.parallel.mesh import destroy_parallel
+
+    ctx = initialize_parallel(dp=1, pp=1, tp=8, sequence_parallel=True)
+    yield ctx
+    destroy_parallel()
