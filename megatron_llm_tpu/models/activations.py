@@ -1,8 +1,11 @@
 """GLU activation zoo (ref: megatron/model/glu_activations.py:24-55).
 
-Each GLU splits the doubled up-projection in half along the last dim and
-gates: act(x1) * x2. The registry mirrors the reference's
-`GLU_ACTIVATIONS` dict (ref: glu_activations.py:50-55).
+Each GLU gates an up-projection: act(gate) * up. The packed-tensor helpers
+(`*_packed`) split the last dim in half like the reference; the two-argument
+forms are used by the MLP, whose weights keep gate/up on a dedicated axis
+(see models/transformer.py) so TP sharding never crosses the boundary.
+The registry mirrors the reference's `GLU_ACTIVATIONS` dict
+(ref: glu_activations.py:50-55).
 """
 
 from __future__ import annotations
@@ -11,28 +14,20 @@ import jax
 import jax.numpy as jnp
 
 
-def _split(x: jnp.ndarray):
-    return jnp.split(x, 2, axis=-1)
+def liglu(gate, up):
+    return gate * up
 
 
-def liglu(x):
-    a, b = _split(x)
-    return a * b
+def geglu(gate, up):
+    return jax.nn.gelu(gate, approximate=False) * up
 
 
-def geglu(x):
-    a, b = _split(x)
-    return jax.nn.gelu(a, approximate=False) * b
+def reglu(gate, up):
+    return jax.nn.relu(gate) * up
 
 
-def reglu(x):
-    a, b = _split(x)
-    return jax.nn.relu(a) * b
-
-
-def swiglu(x):
-    a, b = _split(x)
-    return jax.nn.silu(a) * b
+def swiglu(gate, up):
+    return jax.nn.silu(gate) * up
 
 
 GLU_ACTIVATIONS = {
@@ -50,8 +45,15 @@ ACTIVATIONS = {
 }
 
 
-def mlp_activation(cfg):
-    """Resolve the MLP activation from config (GLU takes precedence)."""
-    if cfg.glu_activation is not None:
-        return GLU_ACTIVATIONS[cfg.glu_activation]
-    return ACTIVATIONS[cfg.hidden_act]
+def _packed(fn):
+    def apply(x):
+        gate, up = jnp.split(x, 2, axis=-1)
+        return fn(gate, up)
+
+    return apply
+
+
+# Reference-layout variants taking one packed [gate; up] tensor
+# (ref: glu_activations.py:24-47 chunk(2, dim=-1)); used by the checkpoint
+# converters and activation parity tests.
+GLU_ACTIVATIONS_PACKED = {name: _packed(fn) for name, fn in GLU_ACTIVATIONS.items()}

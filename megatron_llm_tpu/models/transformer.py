@@ -22,7 +22,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from megatron_llm_tpu.models.activations import mlp_activation
+from megatron_llm_tpu.models.activations import ACTIVATIONS, GLU_ACTIVATIONS
 from megatron_llm_tpu.models.attention import attention_block
 from megatron_llm_tpu.models.norms import apply_norm
 from megatron_llm_tpu.parallel.mesh import shard_activation
@@ -68,14 +68,25 @@ def init_layer_params(cfg, key, num_layers: Optional[int] = None) -> dict:
             dt,
         ),
     }
+    # GLU up-projections are stored (L, h, 2, ffn) — the gate/up axis kept
+    # separate from the ffn axis — so TP sharding of ffn over the model axis
+    # never crosses the gate/up boundary (the reference packs them into one
+    # 2*ffn dim, ref: transformer.py:92-102, which forces an interleaved
+    # per-rank layout; checkpoint converters reshape (h, 2*ffn) <-> (h, 2, ffn)).
+    if cfg.glu_activation:
+        w1_shape = (L, h, 2, cfg.ffn_hidden_size)
+        b1_shape = (L, 2, cfg.ffn_hidden_size)
+    else:
+        w1_shape = (L, h, cfg.ffn_hidden_size)
+        b1_shape = (L, cfg.ffn_hidden_size)
     mlp = {
-        "w1": _normal(keys[2], (L, h, cfg.mlp_input_size), std, dt),
+        "w1": _normal(keys[2], w1_shape, std, dt),
         "w2": _normal(keys[3], (L, cfg.ffn_hidden_size, h), out_std, dt),
     }
     if cfg.use_bias:
         attn["bqkv"] = jnp.zeros((L, cfg.qkv_projection_size), dt)
         attn["bo"] = jnp.zeros((L, h), dt)
-        mlp["b1"] = jnp.zeros((L, cfg.mlp_input_size), dt)
+        mlp["b1"] = jnp.zeros(b1_shape, dt)
         mlp["b2"] = jnp.zeros((L, h), dt)
 
     layers = {
@@ -98,13 +109,23 @@ def init_layer_params(cfg, key, num_layers: Optional[int] = None) -> dict:
 
 
 def mlp_block(mlp_params, cfg, hidden, dropout_rng, deterministic):
-    """ParallelMLP (ref: transformer.py:77-142): h -> [2*]ffn -> act -> h."""
+    """ParallelMLP (ref: transformer.py:77-142): h -> [2x]ffn -> act -> h."""
     dt = cfg.compute_dtype
-    x = hidden @ mlp_params["w1"].astype(dt)
-    if "b1" in mlp_params:
-        x = x + mlp_params["b1"].astype(dt)
+    w1 = mlp_params["w1"].astype(dt)
+    if cfg.glu_activation:
+        # (b,s,h) @ (h,2,f) -> (b,s,2,f); gate/up on their own axis.
+        x = jnp.einsum("bsh,hcf->bscf", hidden, w1)
+        if "b1" in mlp_params:
+            x = x + mlp_params["b1"].astype(dt)
+        x = shard_activation(x, "glu_ffn")
+        act = GLU_ACTIVATIONS[cfg.glu_activation]
+        x = act(x[..., 0, :], x[..., 1, :])
+    else:
+        x = hidden @ w1
+        if "b1" in mlp_params:
+            x = x + mlp_params["b1"].astype(dt)
+        x = ACTIVATIONS[cfg.hidden_act](x)
     x = shard_activation(x, "ffn")
-    x = mlp_activation(cfg)(x)
     x = x @ mlp_params["w2"].astype(dt)
     if "b2" in mlp_params:
         x = x + mlp_params["b2"].astype(dt)

@@ -1,0 +1,11 @@
+from megatron_llm_tpu.optimizer.optimizer import (  # noqa: F401
+    OptimizerState,
+    get_optimizer,
+    init_optimizer_state,
+    optimizer_step,
+)
+from megatron_llm_tpu.optimizer.scheduler import OptimizerParamScheduler  # noqa: F401
+from megatron_llm_tpu.optimizer.grad_scaler import (  # noqa: F401
+    ConstantGradScaler,
+    DynamicGradScaler,
+)

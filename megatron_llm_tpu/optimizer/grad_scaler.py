@@ -1,0 +1,94 @@
+"""Loss scaling for fp16 (ref: megatron/optimizer/grad_scaler.py).
+
+bf16 on TPU needs no scaling (SURVEY.md §7 design stance); these exist for
+fp16 parity. `DynamicGradScaler` doubles every `growth_interval` clean steps
+and halves on overflow with hysteresis (ref: grad_scaler.py:53-125,
+args arguments.py:788-798). State is a plain dict so it jits/checkpoints.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ConstantGradScaler:
+    def __init__(self, scale: float):
+        self._scale = jnp.float32(scale)
+
+    def init_state(self) -> dict:
+        return {}
+
+    def scale(self, state):
+        return self._scale
+
+    def update(self, state, found_inf):
+        return state
+
+    def state_dict(self, state):
+        return {"scale": float(self._scale)}
+
+    def load_state_dict(self, state, sd):
+        self._scale = jnp.float32(sd["scale"])
+        return state
+
+
+class DynamicGradScaler:
+    def __init__(
+        self,
+        initial_scale: float = 2.0**32,
+        min_scale: float = 1.0,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 1000,
+        hysteresis: int = 2,
+    ):
+        assert initial_scale > 0 and min_scale > 0
+        assert growth_factor > 1.0 and 0.0 < backoff_factor < 1.0
+        self.initial_scale = initial_scale
+        self.min_scale = min_scale
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self.hysteresis = hysteresis
+
+    def init_state(self) -> dict:
+        return {
+            "scale": jnp.float32(self.initial_scale),
+            "growth_tracker": jnp.int32(0),
+            "hysteresis_tracker": jnp.int32(self.hysteresis),
+        }
+
+    def scale(self, state):
+        return state["scale"]
+
+    def update(self, state, found_inf):
+        """Pure-functional form of ref grad_scaler.py:90-116."""
+        found_inf = found_inf.astype(bool)
+        hyst = jnp.where(
+            found_inf, state["hysteresis_tracker"] - 1, jnp.int32(self.hysteresis)
+        )
+        backoff = found_inf & (hyst <= 0)
+        new_scale = jnp.where(
+            backoff,
+            jnp.maximum(state["scale"] * self.backoff_factor, self.min_scale),
+            state["scale"],
+        )
+        growth = jnp.where(found_inf, 0, state["growth_tracker"] + 1)
+        grow = growth == self.growth_interval
+        new_scale = jnp.where(grow, new_scale * self.growth_factor, new_scale)
+        growth = jnp.where(grow, 0, growth)
+        return {
+            "scale": new_scale,
+            "growth_tracker": growth,
+            "hysteresis_tracker": jnp.where(backoff, jnp.int32(self.hysteresis), hyst),
+        }
+
+    def state_dict(self, state):
+        return {k: float(v) if k == "scale" else int(v) for k, v in state.items()}
+
+    def load_state_dict(self, state, sd):
+        return {
+            "scale": jnp.float32(sd["scale"]),
+            "growth_tracker": jnp.int32(sd["growth_tracker"]),
+            "hysteresis_tracker": jnp.int32(sd["hysteresis_tracker"]),
+        }

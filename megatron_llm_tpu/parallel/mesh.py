@@ -134,6 +134,8 @@ _ACTIVATION_SPECS = {
     "groups": P(DATA_AXIS, None, MODEL_AXIS, None, None),
     # (batch, seq, ffn) MLP intermediate — ffn over model axis
     "ffn": P(DATA_AXIS, None, MODEL_AXIS),
+    # (batch, seq, 2, ffn) GLU intermediate, gate/up axis unsharded
+    "glu_ffn": P(DATA_AXIS, None, None, MODEL_AXIS),
     # (batch, seq, vocab) logits — vocab-parallel
     # (ref: layers.py:128-210 VocabParallelEmbedding / parallel_lm_logits)
     "logits": P(DATA_AXIS, None, MODEL_AXIS),

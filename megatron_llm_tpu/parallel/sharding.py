@@ -1,0 +1,111 @@
+"""Parameter sharding rules — the TPU analogue of the reference's
+Column/RowParallelLinear partitioning (ref: core/tensor_parallel/layers.py:
+410,566 and VocabParallelEmbedding :128).
+
+Instead of per-layer wrapper modules issuing collectives, each weight gets a
+`PartitionSpec` over the (data, stage, model) mesh and GSPMD materialises the
+same communication pattern:
+
+- column-parallel (wqkv, mlp w1): output dim sharded over `model`
+  (identity fwd / psum bwd conjugate pair, ref: mappings.py:127-141)
+- row-parallel (wo, mlp w2): input dim sharded over `model`
+  (psum fwd / identity bwd, ref: mappings.py:143-157)
+- vocab-parallel (embedding, lm_head): vocab dim over `model`
+- norms / small biases: replicated (their grads are psum'd by GSPMD, the
+  analogue of the SP layernorm-grad allreduce, ref: optimizer.py:257-277)
+
+ZeRO-1 optimizer-state sharding (ref: distrib_optimizer.py) adds the `data`
+axis to the largest divisible free axis of each state leaf.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, ParallelContext
+
+
+def param_specs(cfg, params: dict) -> dict:
+    """PartitionSpec pytree matching a language-model param tree."""
+
+    def layer_specs(layers: dict) -> dict:
+        specs: dict = {
+            "input_norm": jax.tree.map(lambda _: P(), layers["input_norm"]),
+            "attention": {},
+            "mlp": {},
+        }
+        attn = {"wqkv": P(None, None, MODEL_AXIS), "wo": P(None, MODEL_AXIS, None)}
+        if "bqkv" in layers["attention"]:
+            attn["bqkv"] = P(None, MODEL_AXIS)
+            attn["bo"] = P(None, None)
+        specs["attention"] = attn
+        if cfg.glu_activation:
+            mlp = {"w1": P(None, None, None, MODEL_AXIS), "w2": P(None, MODEL_AXIS, None)}
+            if "b1" in layers["mlp"]:
+                mlp["b1"] = P(None, None, MODEL_AXIS)
+                mlp["b2"] = P(None, None)
+        else:
+            mlp = {"w1": P(None, None, MODEL_AXIS), "w2": P(None, MODEL_AXIS, None)}
+            if "b1" in layers["mlp"]:
+                mlp["b1"] = P(None, MODEL_AXIS)
+                mlp["b2"] = P(None, None)
+        specs["mlp"] = mlp
+        for name in ("post_attention_norm", "mlp_norm"):
+            if name in layers:
+                specs[name] = jax.tree.map(lambda _: P(), layers[name])
+        return specs
+
+    specs: dict = {
+        "embedding": {"word_embeddings": P(MODEL_AXIS, None)},
+        "layers": layer_specs(params["layers"]),
+        "final_norm": jax.tree.map(lambda _: P(), params["final_norm"]),
+    }
+    if "position_embeddings" in params["embedding"]:
+        specs["embedding"]["position_embeddings"] = P(None, None)
+    if "lm_head" in params:
+        specs["lm_head"] = P(None, MODEL_AXIS)
+    return specs
+
+
+def param_shardings(ctx: ParallelContext, cfg, params: dict) -> dict:
+    return jax.tree.map(
+        lambda spec: NamedSharding(ctx.mesh, spec),
+        param_specs(cfg, params),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
+    """Add the `data` axis to the first free axis divisible by dp — the
+    GSPMD form of the reference's flat-buffer range sharding
+    (ref: distrib_optimizer.py:63-116). Unlike the reference, shards respect
+    param boundaries; XLA still emits reduce-scatter/all-gather."""
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (p, n) in enumerate(zip(parts, shape)):
+        if p is None and n % dp == 0 and n >= dp:
+            parts[i] = DATA_AXIS
+            return P(*parts)
+    return spec
+
+
+def optimizer_state_specs(cfg, params: dict, dp: int, distributed: bool) -> Any:
+    """Specs for one params-shaped moment tree (m or v)."""
+    specs = param_specs(cfg, params)
+    if not distributed or dp <= 1:
+        return specs
+    flat_params = jax.tree.leaves(params)
+    flat_specs, treedef = jax.tree.flatten(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    out = [
+        zero1_spec(s, p.shape, dp) for s, p in zip(flat_specs, flat_params)
+    ]
+    return jax.tree.unflatten(treedef, out)
+
+
+def batch_specs() -> P:
+    """(batch, seq) host batch: batch dim over data axis."""
+    return P(DATA_AXIS, None)

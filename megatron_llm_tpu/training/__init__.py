@@ -1,0 +1,1 @@
+from megatron_llm_tpu.training.train_step import make_train_step  # noqa: F401

@@ -7,7 +7,10 @@ run anywhere. Must set env vars before jax initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Disable the axon TPU plugin (its sitecustomize registers the TPU whenever
+# PALLAS_AXON_POOL_IPS is set, overriding JAX_PLATFORMS).
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,45 @@
+"""GLU activation math vs torch (analogue of ref tests/test_activations.py:12-47,
+which checks liglu/geglu/reglu/swiglu against hand-computed torch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from megatron_llm_tpu.models.activations import (
+    GLU_ACTIVATIONS,
+    GLU_ACTIVATIONS_PACKED,
+)
+
+
+def _torch_ref(name, x):
+    a, b = torch.chunk(x, 2, dim=-1)
+    if name == "liglu":
+        return a * b
+    if name == "geglu":
+        return torch.nn.functional.gelu(a) * b
+    if name == "reglu":
+        return torch.relu(a) * b
+    if name == "swiglu":
+        return torch.nn.functional.silu(a) * b
+    raise ValueError(name)
+
+
+def test_glu_packed_matches_torch():
+    x_np = np.random.RandomState(0).randn(4, 6, 32).astype(np.float32)
+    for name in GLU_ACTIVATIONS_PACKED:
+        ours = np.asarray(GLU_ACTIVATIONS_PACKED[name](jnp.asarray(x_np)))
+        ref = _torch_ref(name, torch.from_numpy(x_np)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5, err_msg=name)
+
+
+def test_two_arg_matches_packed():
+    """The MLP's two-argument gate/up form == packed split form."""
+    x = jax.random.normal(jax.random.key(0), (2, 8, 64))
+    gate, up = jnp.split(x, 2, axis=-1)
+    for name, fn in GLU_ACTIVATIONS.items():
+        np.testing.assert_allclose(
+            np.asarray(fn(gate, up)),
+            np.asarray(GLU_ACTIVATIONS_PACKED[name](x)),
+            rtol=1e-6, atol=1e-6, err_msg=name,
+        )
