@@ -42,7 +42,7 @@ def main():
         tie_embed_logits=False,
         hidden_dropout=0.0,
         attention_dropout=0.0,
-        params_dtype=jnp.bfloat16,
+        params_dtype=jnp.float32,  # fp32 master params, bf16 compute (design contract)
         recompute_granularity="full",
     )
     model = LlamaModel(cfg)

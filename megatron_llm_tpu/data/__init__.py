@@ -1,0 +1,15 @@
+from megatron_llm_tpu.data.indexed_dataset import (  # noqa: F401
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    make_dataset,
+)
+from megatron_llm_tpu.data.gpt_dataset import (  # noqa: F401
+    GPTDataset,
+    build_train_valid_test_datasets,
+)
+from megatron_llm_tpu.data.blendable_dataset import BlendableDataset  # noqa: F401
+from megatron_llm_tpu.data.data_samplers import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+    build_pretraining_data_loader,
+)

@@ -1,0 +1,31 @@
+"""Weighted mix of datasets (ref: megatron/data/blendable_dataset.py:12-60)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from megatron_llm_tpu.data import helpers
+
+
+class BlendableDataset:
+    def __init__(self, datasets, weights):
+        self.datasets = datasets
+        assert len(datasets) == len(weights)
+        self.size = sum(len(d) for d in datasets)
+        weights = np.asarray(weights, np.float64)
+        assert np.sum(weights) > 0.0
+        weights = weights / np.sum(weights)
+        assert len(datasets) < 255
+        self.dataset_index, self.dataset_sample_index = helpers.build_blending_indices(
+            weights, self.size
+        )
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        d = self.dataset_index[idx]
+        s = self.dataset_sample_index[idx]
+        # modulo guards the 0.5% oversampling headroom (ref behavior relies
+        # on each sub-dataset being built slightly larger than needed)
+        return self.datasets[d][s % len(self.datasets[d])]

@@ -1,0 +1,323 @@
+"""GPT pretraining dataset: document stitching + cached index mappings.
+
+Behavioral parity with ref megatron/data/gpt_dataset.py — identical doc_idx
+/ sample_idx / shuffle_idx construction (same RNG consumption order, same
+cache filenames) so a run on the same corpus produces the same sample order
+as the reference, which is what makes loss-vs-step comparable (SURVEY.md §7
+hard part (e)). Multi-process coordination uses jax.process_index() instead
+of torch.distributed rank (only process 0 builds, others poll the cache
+files).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from megatron_llm_tpu.data import helpers
+from megatron_llm_tpu.data.blendable_dataset import BlendableDataset
+from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDataset, make_dataset
+
+
+def get_datasets_weights_and_num_samples(data_prefix, train_valid_test_num_samples):
+    """ref: dataset_utils.py get_datasets_weights_and_num_samples — parse
+    [w1, p1, w2, p2, ...] and scale per-dataset sample counts (with the
+    reference's 0.5% oversampling headroom)."""
+    assert len(data_prefix) % 2 == 0
+    num_datasets = len(data_prefix) // 2
+    weights = [float(data_prefix[2 * i]) for i in range(num_datasets)]
+    prefixes = [str(data_prefix[2 * i + 1]) for i in range(num_datasets)]
+    total = sum(weights)
+    weights = [w / total for w in weights]
+    datasets_train_valid_test_num_samples = []
+    for w in weights:
+        datasets_train_valid_test_num_samples.append(
+            [int(np.ceil(n * w * 1.005)) for n in train_valid_test_num_samples]
+        )
+    return prefixes, weights, datasets_train_valid_test_num_samples
+
+
+class GPTDataset:
+    """ref: GPTDataset (gpt_dataset.py:221-269)."""
+
+    def __init__(
+        self,
+        name: str,
+        data_prefix: str,
+        documents: np.ndarray,
+        indexed_dataset: MMapIndexedDataset,
+        num_samples: int,
+        seq_length: int,
+        seed: int,
+        build_cache: bool = True,
+    ):
+        self.name = name
+        self.indexed_dataset = indexed_dataset
+        assert np.min(documents) >= 0
+        assert np.max(documents) < indexed_dataset.sizes.shape[0]
+        self.doc_idx, self.sample_idx, self.shuffle_idx = _build_index_mappings(
+            name, data_prefix, documents, indexed_dataset.sizes, num_samples,
+            seq_length, seed, build_cache=build_cache,
+        )
+
+    def __len__(self):
+        # sample i -> [sample_idx[i], sample_idx[i+1]) (ref :238-241)
+        return self.sample_idx.shape[0] - 1
+
+    def __getitem__(self, idx):
+        # Stitch documents into one seq_length+1 token sample (ref :243-269).
+        idx = self.shuffle_idx[idx]
+        doc_f, off_f = self.sample_idx[idx]
+        doc_l, off_l = self.sample_idx[idx + 1]
+        if doc_f == doc_l:
+            sample = self.indexed_dataset.get(
+                self.doc_idx[doc_f], offset=off_f, length=off_l - off_f + 1
+            )
+        else:
+            parts = [self.indexed_dataset.get(self.doc_idx[doc_f], offset=off_f)]
+            for i in range(doc_f + 1, doc_l):
+                parts.append(self.indexed_dataset.get(self.doc_idx[i]))
+            parts.append(self.indexed_dataset.get(self.doc_idx[doc_l], length=off_l + 1))
+            sample = np.concatenate(parts)
+        return {"text": np.asarray(sample, np.int64)}
+
+
+def _num_tokens(documents, sizes) -> int:
+    return int(np.sum(sizes[documents]))
+
+
+def _num_epochs(tokens_per_epoch, seq_length, num_samples) -> int:
+    """ref: gpt_dataset.py:414-425 (the -1 is the boundary-token overlap)."""
+    num_epochs = 0
+    total_tokens = 0
+    while True:
+        num_epochs += 1
+        total_tokens += tokens_per_epoch
+        if (total_tokens - 1) // seq_length >= num_samples:
+            return num_epochs
+
+
+def _build_doc_idx(documents, num_epochs, np_rng, separate_last_epoch):
+    """ref: gpt_dataset.py:428-442 — same RNG call order."""
+    if not separate_last_epoch or num_epochs == 1:
+        doc_idx = np.mgrid[0:num_epochs, 0 : len(documents)][1]
+        doc_idx[:] = documents
+        doc_idx = doc_idx.reshape(-1).astype(np.int32)
+        np_rng.shuffle(doc_idx)
+        return doc_idx
+    doc_idx_first = _build_doc_idx(documents, num_epochs - 1, np_rng, False)
+    doc_idx_last = _build_doc_idx(documents, 1, np_rng, False)
+    return np.concatenate((doc_idx_first, doc_idx_last))
+
+
+def _build_shuffle_idx(num_samples, total_size, np_rng):
+    """ref: gpt_dataset.py:494-513 — first/last-epoch split shuffle."""
+    dtype_ = np.uint32
+    if total_size >= (np.iinfo(np.uint32).max - 1):
+        dtype_ = np.int64
+    shuffle_idx_first = np.arange(0, num_samples, dtype=dtype_)
+    np_rng.shuffle(shuffle_idx_first)
+    if num_samples == total_size:
+        return shuffle_idx_first
+    shuffle_idx_last = np.arange(num_samples, total_size, dtype=dtype_)
+    np_rng.shuffle(shuffle_idx_last)
+    return np.concatenate((shuffle_idx_first, shuffle_idx_last))
+
+
+def _is_lead_process() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def _build_index_mappings(
+    name, data_prefix, documents, sizes, num_samples, seq_length, seed,
+    build_cache: bool = True,
+):
+    """ref: gpt_dataset.py:272-406 — identical cache naming + construction;
+    in-memory build when build_cache=False (tests, tiny runs)."""
+    tokens_per_epoch = _num_tokens(documents, sizes)
+    num_epochs = _num_epochs(tokens_per_epoch, seq_length, num_samples)
+    np_rng = np.random.RandomState(seed=seed)
+
+    _filename = data_prefix
+    _filename += f"_{name}_indexmap"
+    _filename += f"_{num_samples}ns"
+    _filename += f"_{seq_length}sl"
+    _filename += f"_{seed}s"
+    doc_idx_filename = _filename + "_doc_idx.npy"
+    sample_idx_filename = _filename + "_sample_idx.npy"
+    shuffle_idx_filename = _filename + "_shuffle_idx.npy"
+
+    cached = all(
+        os.path.isfile(f)
+        for f in (doc_idx_filename, sample_idx_filename, shuffle_idx_filename)
+    )
+
+    if not cached:
+        # separate-last-epoch decision (ref :305-341)
+        if num_epochs == 1:
+            separate_last_epoch = False
+        else:
+            num_samples_from_epochs_minus_one = (
+                (num_epochs - 1) * tokens_per_epoch - 1
+            ) // seq_length
+            last_epoch_num_samples = num_samples - num_samples_from_epochs_minus_one
+            assert last_epoch_num_samples >= 0
+            num_samples_per_epoch = (tokens_per_epoch - 1) // seq_length
+            assert last_epoch_num_samples < num_samples_per_epoch + 1
+            separate_last_epoch = last_epoch_num_samples < int(
+                0.80 * num_samples_per_epoch
+            )
+
+        if _is_lead_process() or not build_cache:
+            doc_idx = _build_doc_idx(documents, num_epochs, np_rng, separate_last_epoch)
+            sample_idx = helpers.build_sample_idx(
+                sizes, doc_idx, seq_length, num_epochs, tokens_per_epoch
+            )
+            if separate_last_epoch:
+                num_samples_ = num_samples_from_epochs_minus_one
+            else:
+                num_samples_ = sample_idx.shape[0] - 1
+            shuffle_idx = _build_shuffle_idx(
+                num_samples_, sample_idx.shape[0] - 1, np_rng
+            )
+            if not build_cache:
+                return doc_idx, sample_idx, shuffle_idx
+            np.save(doc_idx_filename, doc_idx, allow_pickle=True)
+            np.save(sample_idx_filename, sample_idx, allow_pickle=True)
+            np.save(shuffle_idx_filename, shuffle_idx, allow_pickle=True)
+        else:
+            # non-lead processes wait for the cache (ref pseudo-barrier :378-386)
+            deadline = time.time() + 600
+            while not all(
+                os.path.isfile(f)
+                for f in (doc_idx_filename, sample_idx_filename, shuffle_idx_filename)
+            ):
+                if time.time() > deadline:
+                    raise TimeoutError("index mapping cache never appeared")
+                time.sleep(1)
+
+    doc_idx = np.load(doc_idx_filename, allow_pickle=True, mmap_mode="r")
+    sample_idx = np.load(sample_idx_filename, allow_pickle=True, mmap_mode="r")
+    shuffle_idx = np.load(shuffle_idx_filename, allow_pickle=True, mmap_mode="r")
+    return doc_idx, sample_idx, shuffle_idx
+
+
+def get_train_valid_test_split_(splits_string, size):
+    """ref: dataset_utils.py:get_train_valid_test_split_ — '969,30,1' style."""
+    splits = []
+    if splits_string.find(",") != -1:
+        splits = [float(s) for s in splits_string.split(",")]
+    elif splits_string.find("/") != -1:
+        splits = [float(s) for s in splits_string.split("/")]
+    else:
+        splits = [float(splits_string)]
+    while len(splits) < 3:
+        splits.append(0.0)
+    splits = splits[:3]
+    splits_sum = sum(splits)
+    assert splits_sum > 0.0
+    splits = [split / splits_sum for split in splits]
+    splits_index = [0]
+    for index, split in enumerate(splits):
+        splits_index.append(splits_index[index] + int(round(split * float(size))))
+    diff = splits_index[-1] - size
+    for index in range(1, len(splits_index)):
+        splits_index[index] -= diff
+    assert len(splits_index) == 4
+    assert splits_index[-1] == size
+    return splits_index
+
+
+def _build_single(
+    data_prefix, data_impl, splits_string, train_valid_test_num_samples,
+    seq_length, seed, build_cache=True,
+):
+    """ref: _build_train_valid_test_datasets (gpt_dataset.py:131-218)."""
+    indexed_dataset = make_dataset(data_prefix, data_impl)
+    total_num_docs = indexed_dataset.sizes.shape[0]
+    splits = get_train_valid_test_split_(splits_string, total_num_docs)
+
+    def build_dataset(index, name):
+        if splits[index + 1] <= splits[index]:
+            return None
+        documents = np.arange(splits[index], splits[index + 1], dtype=np.int32)
+        return GPTDataset(
+            name, data_prefix, documents, indexed_dataset,
+            train_valid_test_num_samples[index], seq_length, seed,
+            build_cache=build_cache,
+        )
+
+    return (
+        build_dataset(0, "train"),
+        build_dataset(1, "valid"),
+        build_dataset(2, "test"),
+    )
+
+
+def build_train_valid_test_datasets(
+    data_prefix,
+    data_impl: str = "mmap",
+    splits_string: str = "969,30,1",
+    train_valid_test_num_samples: Sequence[int] = (0, 0, 0),
+    seq_length: int = 2048,
+    seed: int = 1234,
+    train_data_prefix=None,
+    valid_data_prefix=None,
+    test_data_prefix=None,
+    build_cache: bool = True,
+):
+    """ref: build_train_valid_test_datasets (gpt_dataset.py:20-128):
+    single corpus, weighted multi-corpus blend, or separate
+    train/valid/test prefixes."""
+    if data_prefix is not None:
+        if isinstance(data_prefix, (str, os.PathLike)):
+            return _build_single(
+                data_prefix, data_impl, splits_string,
+                train_valid_test_num_samples, seq_length, seed, build_cache,
+            )
+        if len(data_prefix) == 1:
+            return _build_single(
+                data_prefix[0], data_impl, splits_string,
+                train_valid_test_num_samples, seq_length, seed, build_cache,
+            )
+        # blended multi-corpus (ref :44-76)
+        prefixes, weights, per_ds_nums = get_datasets_weights_and_num_samples(
+            data_prefix, train_valid_test_num_samples
+        )
+        train_sets, valid_sets, test_sets = [], [], []
+        for prefix, nums in zip(prefixes, per_ds_nums):
+            tr, va, te = _build_single(
+                prefix, data_impl, splits_string, nums, seq_length, seed,
+                build_cache,
+            )
+            if tr:
+                train_sets.append(tr)
+            if va:
+                valid_sets.append(va)
+            if te:
+                test_sets.append(te)
+        blend = lambda ds: BlendableDataset(ds, weights) if ds else None
+        return blend(train_sets), blend(valid_sets), blend(test_sets)
+
+    # separate prefixes per split (ref :78-128)
+    def single(prefix, name, n):
+        if prefix is None:
+            return None
+        ds = make_dataset(prefix, data_impl)
+        documents = np.arange(ds.sizes.shape[0], dtype=np.int32)
+        return GPTDataset(name, prefix, documents, ds, n, seq_length, seed,
+                          build_cache=build_cache)
+
+    return (
+        single(train_data_prefix, "train", train_valid_test_num_samples[0]),
+        single(valid_data_prefix, "valid", train_valid_test_num_samples[1]),
+        single(test_data_prefix, "test", train_valid_test_num_samples[2]),
+    )

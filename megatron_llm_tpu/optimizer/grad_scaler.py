@@ -62,10 +62,13 @@ class DynamicGradScaler:
         return state["scale"]
 
     def update(self, state, found_inf):
-        """Pure-functional form of ref grad_scaler.py:90-116."""
+        """Pure-functional form of ref grad_scaler.py:85-116: on overflow the
+        hysteresis tracker decrements (clean steps do NOT replenish it) and
+        the scale backs off once it reaches zero; `growth_interval`
+        consecutive clean steps grow the scale and restore the tracker."""
         found_inf = found_inf.astype(bool)
         hyst = jnp.where(
-            found_inf, state["hysteresis_tracker"] - 1, jnp.int32(self.hysteresis)
+            found_inf, state["hysteresis_tracker"] - 1, state["hysteresis_tracker"]
         )
         backoff = found_inf & (hyst <= 0)
         new_scale = jnp.where(
@@ -73,14 +76,16 @@ class DynamicGradScaler:
             jnp.maximum(state["scale"] * self.backoff_factor, self.min_scale),
             state["scale"],
         )
+        hyst = jnp.where(backoff, jnp.int32(self.hysteresis), hyst)
         growth = jnp.where(found_inf, 0, state["growth_tracker"] + 1)
         grow = growth == self.growth_interval
         new_scale = jnp.where(grow, new_scale * self.growth_factor, new_scale)
         growth = jnp.where(grow, 0, growth)
+        hyst = jnp.where(grow, jnp.int32(self.hysteresis), hyst)
         return {
             "scale": new_scale,
             "growth_tracker": growth,
-            "hysteresis_tracker": jnp.where(backoff, jnp.int32(self.hysteresis), hyst),
+            "hysteresis_tracker": hyst,
         }
 
     def state_dict(self, state):

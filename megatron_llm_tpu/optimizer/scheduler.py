@@ -73,10 +73,13 @@ class OptimizerParamScheduler:
     # -- wd (ref: optimizer_param_scheduler.py:53-76) ---------------------
     def get_wd(self, step: Optional[int] = None) -> float:
         step = self.num_steps if step is None else step
-        if self.wd_incr_steps is None or self.wd_incr_style == "constant":
-            assert self.start_wd == self.end_wd or self.wd_incr_steps is not None
-            if self.wd_incr_style == "constant":
-                return self.end_wd
+        if self.wd_incr_style == "constant":
+            assert self.start_wd == self.end_wd
+            return self.end_wd
+        if self.wd_incr_steps is None:
+            raise ValueError(
+                f"wd_incr_style={self.wd_incr_style!r} requires wd_incr_steps"
+            )
         frac = min(step / max(self.wd_incr_steps, 1), 1.0)
         delta = self.end_wd - self.start_wd
         if self.wd_incr_style == "linear":

@@ -1,0 +1,1 @@
+from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer  # noqa: F401

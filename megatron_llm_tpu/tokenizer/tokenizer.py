@@ -1,0 +1,425 @@
+"""Tokenizer registry + vocab padding.
+
+Parity target: ref megatron/tokenizer/tokenizer.py:12-499 —
+`build_tokenizer` dispatch, vocab padding to a multiple of
+`make_vocab_size_divisible_by * tp` (:49-63), and the tokenizer classes:
+BertWordPiece (:123), GPT2BPE (:254), Falcon/HF (:288), SentencePiece for
+Llama incl. special + extra tokens (:326-404).
+
+All tokenizers load from local files only (this image has zero egress).
+SentencePiece is optional in the environment; the Llama path also accepts a
+HF `tokenizer.json` via the `tokenizers` library.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+
+def pad_vocab_size(orig_vocab_size: int, make_vocab_size_divisible_by: int,
+                   tensor_parallel_size: int) -> int:
+    """ref: _vocab_size_with_padding (tokenizer.py:49-63)."""
+    after = orig_vocab_size
+    multiple = make_vocab_size_divisible_by * tensor_parallel_size
+    while after % multiple != 0:
+        after += 1
+    return after
+
+
+class AbstractTokenizer(ABC):
+    """ref: AbstractTokenizer (tokenizer.py:66-120)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @property
+    @abstractmethod
+    def vocab_size(self) -> int: ...
+
+    @property
+    @abstractmethod
+    def vocab(self) -> dict: ...
+
+    @property
+    @abstractmethod
+    def inv_vocab(self) -> dict: ...
+
+    @abstractmethod
+    def tokenize(self, text: str) -> List[int]: ...
+
+    def detokenize(self, token_ids) -> str:
+        raise NotImplementedError(f"detokenizer not implemented for {self.name}")
+
+    @property
+    def cls(self):
+        raise NotImplementedError
+
+    @property
+    def sep(self):
+        raise NotImplementedError
+
+    @property
+    def pad(self):
+        raise NotImplementedError
+
+    @property
+    def eod(self):
+        raise NotImplementedError
+
+    @property
+    def mask(self):
+        raise NotImplementedError
+
+
+class _GPT2BPETokenizer(AbstractTokenizer):
+    """ref: tokenizer.py:254-287."""
+
+    def __init__(self, vocab_file: str, merges_file: str):
+        super().__init__("GPT2 BPE")
+        from megatron_llm_tpu.tokenizer.gpt2_bpe import GPT2BPE
+
+        self.tokenizer = GPT2BPE(vocab_file, merges_file)
+        self.eod_id = self.tokenizer.encoder["<|endoftext|>"]
+
+    @property
+    def vocab_size(self):
+        return len(self.tokenizer.encoder)
+
+    @property
+    def vocab(self):
+        return self.tokenizer.encoder
+
+    @property
+    def inv_vocab(self):
+        return self.tokenizer.decoder
+
+    def tokenize(self, text):
+        return self.tokenizer.encode(text)
+
+    def detokenize(self, token_ids):
+        return self.tokenizer.decode(token_ids)
+
+    @property
+    def eod(self):
+        return self.eod_id
+
+
+class _SentencePieceTokenizer(AbstractTokenizer):
+    """Llama tokenizer (ref: tokenizer.py:326-474): SentencePiece model +
+    special tokens (<s>, </s>, [INST]... when vocab_extra_ids_list) and
+    `new_tokens` gating."""
+
+    def __init__(self, model_file: str, vocab_extra_ids_list: Optional[str] = None,
+                 new_tokens: bool = True):
+        super().__init__("SentencePieceTokenizer")
+        import sentencepiece as spm  # optional dependency
+
+        self.tokenizer = spm.SentencePieceProcessor(model_file=model_file)
+        self._vocab = {self.tokenizer.id_to_piece(i): i
+                       for i in range(self.tokenizer.get_piece_size())}
+        self._inv_vocab = {i: p for p, i in self._vocab.items()}
+        self._special_tokens = {}
+        self._next_id = self.tokenizer.get_piece_size()
+        if vocab_extra_ids_list and new_tokens:
+            for tok in vocab_extra_ids_list.split(","):
+                self._add_special(tok)
+
+    def _add_special(self, tok: str):
+        if tok not in self._vocab:
+            self._vocab[tok] = self._next_id
+            self._inv_vocab[self._next_id] = tok
+            self._special_tokens[tok] = self._next_id
+            self._next_id += 1
+
+    @property
+    def vocab_size(self):
+        return self._next_id
+
+    @property
+    def vocab(self):
+        return self._vocab
+
+    @property
+    def inv_vocab(self):
+        return self._inv_vocab
+
+    def tokenize(self, text):
+        return self.tokenizer.encode(text)
+
+    def detokenize(self, token_ids):
+        return self.tokenizer.decode([int(t) for t in token_ids])
+
+    @property
+    def bos(self):
+        return self.tokenizer.bos_id()
+
+    @property
+    def eos(self):
+        return self.tokenizer.eos_id()
+
+    @property
+    def eod(self):
+        return self.tokenizer.eos_id()
+
+    @property
+    def pad(self):
+        return self.tokenizer.pad_id()
+
+
+class _HFTokenizer(AbstractTokenizer):
+    """HF tokenizers-backed wrapper (ref: _FalconTokenizer tokenizer.py:288-325
+    uses transformers AutoTokenizer). Loads a local tokenizer.json or a
+    local pretrained directory."""
+
+    def __init__(self, path: str, name: str = "HFTokenizer"):
+        super().__init__(name)
+        import os
+
+        if os.path.isdir(path):
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(path, local_files_only=True)
+            self._encode = lambda t: self.tokenizer(t)["input_ids"]
+            self._decode = self.tokenizer.decode
+            self._size = len(self.tokenizer)
+            self._vocab = self.tokenizer.get_vocab()
+            self._eod = self.tokenizer.eos_token_id
+        else:
+            from tokenizers import Tokenizer
+
+            self.tokenizer = Tokenizer.from_file(path)
+            self._encode = lambda t: self.tokenizer.encode(t).ids
+            self._decode = self.tokenizer.decode
+            self._size = self.tokenizer.get_vocab_size()
+            self._vocab = self.tokenizer.get_vocab()
+            eos = None
+            for cand in ("</s>", "<|endoftext|>", "<|end_of_text|>"):
+                if cand in self._vocab:
+                    eos = self._vocab[cand]
+                    break
+            self._eod = eos
+        self._inv_vocab = {v: k for k, v in self._vocab.items()}
+
+    @property
+    def vocab_size(self):
+        return self._size
+
+    @property
+    def vocab(self):
+        return self._vocab
+
+    @property
+    def inv_vocab(self):
+        return self._inv_vocab
+
+    def tokenize(self, text):
+        return self._encode(text)
+
+    def detokenize(self, token_ids):
+        return self._decode([int(t) for t in token_ids])
+
+    @property
+    def eod(self):
+        return self._eod
+
+
+class _FalconTokenizer(_HFTokenizer):
+    """ref: tokenizer.py:288-325 (tiiuae/falcon HF tokenizer from local dir)."""
+
+    def __init__(self, path: str):
+        super().__init__(path, name="FalconTokenizer")
+
+
+class _NullTokenizer(AbstractTokenizer):
+    """Integer pass-through for pre-tokenized corpora and tests."""
+
+    def __init__(self, vocab_size: int):
+        super().__init__("NullTokenizer")
+        self._size = int(vocab_size)
+
+    @property
+    def vocab_size(self):
+        return self._size + 1  # +1 for eod
+
+    @property
+    def vocab(self):
+        return {str(i): i for i in range(self.vocab_size)}
+
+    @property
+    def inv_vocab(self):
+        return {i: str(i) for i in range(self.vocab_size)}
+
+    def tokenize(self, text):
+        return [int(t) for t in text.split()]
+
+    def detokenize(self, token_ids):
+        return " ".join(str(int(t)) for t in token_ids)
+
+    @property
+    def eod(self):
+        return self._size
+
+
+def build_tokenizer(
+    tokenizer_type: str,
+    vocab_file: Optional[str] = None,
+    merges_file: Optional[str] = None,
+    tokenizer_model: Optional[str] = None,
+    make_vocab_size_divisible_by: int = 128,
+    tensor_parallel_size: int = 1,
+    vocab_extra_ids_list: Optional[str] = None,
+    new_tokens: bool = True,
+    null_vocab_size: Optional[int] = None,
+):
+    """ref: build_tokenizer (tokenizer.py:12-47). Returns tokenizer with
+    `padded_vocab_size` attribute set."""
+    if tokenizer_type == "GPT2BPETokenizer":
+        assert vocab_file and merges_file
+        tokenizer = _GPT2BPETokenizer(vocab_file, merges_file)
+    elif tokenizer_type == "SentencePieceTokenizer":
+        assert tokenizer_model
+        tokenizer = _SentencePieceTokenizer(
+            tokenizer_model, vocab_extra_ids_list, new_tokens
+        )
+    elif tokenizer_type == "FalconTokenizer":
+        tokenizer = _FalconTokenizer(tokenizer_model or vocab_file)
+    elif tokenizer_type == "HFTokenizer":
+        tokenizer = _HFTokenizer(tokenizer_model or vocab_file)
+    elif tokenizer_type == "BertWordPieceLowerCase":
+        tokenizer = _BertWordPieceTokenizer(vocab_file, lower_case=True)
+    elif tokenizer_type == "BertWordPieceCase":
+        tokenizer = _BertWordPieceTokenizer(vocab_file, lower_case=False)
+    elif tokenizer_type == "NullTokenizer":
+        tokenizer = _NullTokenizer(null_vocab_size or 0)
+    else:
+        raise NotImplementedError(f"{tokenizer_type} tokenizer is not implemented")
+
+    tokenizer.padded_vocab_size = pad_vocab_size(
+        tokenizer.vocab_size, make_vocab_size_divisible_by, tensor_parallel_size
+    )
+    return tokenizer
+
+
+class _BertWordPieceTokenizer(AbstractTokenizer):
+    """WordPiece tokenizer for BERT (ref: tokenizer.py:123-253 +
+    bert_tokenization.py). Compact re-implementation: basic whitespace/punct
+    split then greedy longest-match wordpieces."""
+
+    def __init__(self, vocab_file: str, lower_case: bool = True):
+        super().__init__(
+            "BERT Lower Case" if lower_case else "BERT Upper Case"
+        )
+        self.lower_case = lower_case
+        self._vocab = {}
+        with open(vocab_file, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                tok = line.rstrip("\n")
+                if tok:
+                    self._vocab[tok] = i
+        self._inv = {v: k for k, v in self._vocab.items()}
+        self.cls_id = self._vocab["[CLS]"]
+        self.sep_id = self._vocab["[SEP]"]
+        self.pad_id = self._vocab["[PAD]"]
+        self.mask_id = self._vocab["[MASK]"]
+        self.unk_id = self._vocab.get("[UNK]", 0)
+
+    # -- basic tokenization ------------------------------------------------
+    @staticmethod
+    def _is_punct(ch):
+        import unicodedata
+
+        cp = ord(ch)
+        if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+            return True
+        return unicodedata.category(ch).startswith("P")
+
+    def _basic_tokenize(self, text: str):
+        if self.lower_case:
+            text = text.lower()
+        out, cur = [], []
+        for ch in text:
+            if ch.isspace():
+                if cur:
+                    out.append("".join(cur))
+                    cur = []
+            elif self._is_punct(ch):
+                if cur:
+                    out.append("".join(cur))
+                    cur = []
+                out.append(ch)
+            else:
+                cur.append(ch)
+        if cur:
+            out.append("".join(cur))
+        return out
+
+    def _wordpiece(self, word: str):
+        if len(word) > 200:
+            return [self.unk_id]
+        pieces = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur_id = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self._vocab:
+                    cur_id = self._vocab[sub]
+                    break
+                end -= 1
+            if cur_id is None:
+                return [self.unk_id]
+            pieces.append(cur_id)
+            start = end
+        return pieces
+
+    @property
+    def vocab_size(self):
+        return len(self._vocab)
+
+    @property
+    def vocab(self):
+        return self._vocab
+
+    @property
+    def inv_vocab(self):
+        return self._inv
+
+    def tokenize(self, text):
+        ids = []
+        for word in self._basic_tokenize(text):
+            ids.extend(self._wordpiece(word))
+        return ids
+
+    def detokenize(self, token_ids):
+        toks = [self._inv[int(i)] for i in token_ids]
+        out = []
+        for t in toks:
+            if t.startswith("##") and out:
+                out[-1] = out[-1] + t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)
+
+    @property
+    def cls(self):
+        return self.cls_id
+
+    @property
+    def sep(self):
+        return self.sep_id
+
+    @property
+    def pad(self):
+        return self.pad_id
+
+    @property
+    def mask(self):
+        return self.mask_id
+
+    @property
+    def eod(self):
+        return self.sep_id

@@ -1,0 +1,80 @@
+"""Optimizer/scheduler/scaler unit tests (ref analogue: the semantics of
+optimizer/grad_scaler.py and optimizer_param_scheduler.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import TrainConfig
+from megatron_llm_tpu.optimizer import (
+    DynamicGradScaler,
+    init_optimizer_state,
+    optimizer_step,
+)
+from megatron_llm_tpu.optimizer.scheduler import OptimizerParamScheduler
+
+
+def test_dynamic_scaler_hysteresis():
+    """ref grad_scaler.py:85-106: clean steps do NOT replenish hysteresis;
+    isolated overflows accumulate toward backoff."""
+    sc = DynamicGradScaler(initial_scale=1024.0, hysteresis=2, growth_interval=1000)
+    st = sc.init_state()
+    inf, ok = jnp.bool_(True), jnp.bool_(False)
+    st = sc.update(st, inf)  # tracker 2 -> 1, no backoff
+    assert float(st["scale"]) == 1024.0 and int(st["hysteresis_tracker"]) == 1
+    st = sc.update(st, ok)  # clean step must NOT reset tracker
+    assert int(st["hysteresis_tracker"]) == 1
+    st = sc.update(st, inf)  # tracker -> 0 => backoff + reset
+    assert float(st["scale"]) == 512.0
+    assert int(st["hysteresis_tracker"]) == 2
+
+
+def test_dynamic_scaler_growth():
+    sc = DynamicGradScaler(initial_scale=256.0, growth_interval=3, hysteresis=1)
+    st = sc.init_state()
+    ok = jnp.bool_(False)
+    for _ in range(3):
+        st = sc.update(st, ok)
+    assert float(st["scale"]) == 512.0
+    assert int(st["growth_tracker"]) == 0
+
+
+def test_scaler_min_scale():
+    sc = DynamicGradScaler(initial_scale=2.0, min_scale=1.0, hysteresis=1)
+    st = sc.init_state()
+    inf = jnp.bool_(True)
+    for _ in range(5):
+        st = sc.update(st, inf)
+    assert float(st["scale"]) == 1.0
+
+
+def test_wd_scheduler_requires_steps():
+    sch = OptimizerParamScheduler(max_lr=1e-4, wd_incr_style="linear",
+                                  start_wd=0.0, end_wd=0.1)
+    with pytest.raises(ValueError, match="wd_incr_steps"):
+        sch.get_wd()
+    sch2 = OptimizerParamScheduler(max_lr=1e-4, wd_incr_style="linear",
+                                   start_wd=0.0, end_wd=0.1, wd_incr_steps=100)
+    assert abs(sch2.get_wd(50) - 0.05) < 1e-12
+
+
+def test_adam_bias_correction_first_step():
+    """After one step with constant grad g, adam update ~= lr * sign(g)."""
+    tcfg = TrainConfig(lr=0.1, clip_grad=0.0, weight_decay=0.0, adam_eps=1e-12)
+    params = {"w": jnp.zeros((4,))}
+    state = init_optimizer_state(params, tcfg)
+    grads = {"w": jnp.full((4,), 3.0)}
+    new_p, _, _ = optimizer_step(params, grads, state, tcfg, jnp.float32(0.1))
+    np.testing.assert_allclose(np.asarray(new_p["w"]), -0.1, rtol=1e-5)
+
+
+def test_sgd_momentum():
+    tcfg = TrainConfig(optimizer="sgd", lr=1.0, clip_grad=0.0, weight_decay=0.0,
+                       sgd_momentum=0.9)
+    params = {"w": jnp.zeros(())}
+    state = init_optimizer_state(params, tcfg)
+    g = {"w": jnp.float32(1.0)}
+    p, state, _ = optimizer_step(params, g, state, tcfg, jnp.float32(1.0))
+    assert float(p["w"]) == -1.0
+    p, state, _ = optimizer_step(p, g, state, tcfg, jnp.float32(1.0))
+    np.testing.assert_allclose(float(p["w"]), -1.0 - 1.9, rtol=1e-6)

@@ -1,0 +1,96 @@
+"""Tokenizer tests (ref analogue: implicit contracts of tokenizer.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.tokenizer import build_tokenizer
+from megatron_llm_tpu.tokenizer.tokenizer import pad_vocab_size
+
+
+def test_pad_vocab_size():
+    # ref: tokenizer.py:49-63 — pad to multiple of divisor*tp
+    assert pad_vocab_size(32000, 128, 1) == 32000
+    assert pad_vocab_size(32001, 128, 1) == 32128
+    assert pad_vocab_size(50257, 128, 8) == 51200
+
+
+@pytest.fixture
+def gpt2_files(tmp_path):
+    """Tiny but real BPE: merges building 'he', 'll', 'hell', 'hello'."""
+    # vocab must contain all byte-level symbols used
+    from megatron_llm_tpu.tokenizer.gpt2_bpe import bytes_to_unicode
+
+    b2u = bytes_to_unicode()
+    base = [b2u[b] for b in range(256)]
+    merges = [("h", "e"), ("l", "l"), ("he", "ll"), ("hell", "o"), ("Ġ", "w")]
+    vocab_toks = base + ["he", "ll", "hell", "hello", "Ġw", "<|endoftext|>"]
+    vocab = {t: i for i, t in enumerate(vocab_toks)}
+    vf = tmp_path / "vocab.json"
+    mf = tmp_path / "merges.txt"
+    vf.write_text(json.dumps(vocab))
+    mf.write_text("#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges))
+    return str(vf), str(mf)
+
+
+def test_gpt2_bpe_roundtrip(gpt2_files):
+    vf, mf = gpt2_files
+    tok = build_tokenizer("GPT2BPETokenizer", vocab_file=vf, merges_file=mf)
+    ids = tok.tokenize("hello world")
+    assert tok.detokenize(ids) == "hello world"
+    # greedy merge produced the 'hello' token
+    assert tok.vocab["hello"] in ids
+    assert tok.eod == tok.vocab["<|endoftext|>"]
+    assert tok.padded_vocab_size % 128 == 0
+
+
+@pytest.fixture
+def bert_vocab(tmp_path):
+    toks = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+            "the", "quick", "brown", "fox", "jump", "##s", "##ed", ",", "."]
+    f = tmp_path / "vocab.txt"
+    f.write_text("\n".join(toks))
+    return str(f)
+
+
+def test_bert_wordpiece(bert_vocab):
+    tok = build_tokenizer("BertWordPieceLowerCase", vocab_file=bert_vocab)
+    ids = tok.tokenize("The quick fox jumps.")
+    assert tok.detokenize(ids) == "the quick fox jumps ."
+    assert tok.cls == 2 and tok.sep == 3 and tok.mask == 4 and tok.pad == 0
+    # unknown word -> [UNK]
+    assert tok.tokenize("zebra") == [1]
+
+
+def test_null_tokenizer():
+    tok = build_tokenizer("NullTokenizer", null_vocab_size=1000)
+    assert tok.tokenize("1 2 3") == [1, 2, 3]
+    assert tok.eod == 1000
+
+
+def test_preprocess_cli(tmp_path, gpt2_files):
+    """End-to-end: JSONL -> .bin/.idx -> GPTDataset sample."""
+    vf, mf = gpt2_files
+    corpus = tmp_path / "corpus.jsonl"
+    lines = [json.dumps({"text": "hello world hello"}) for _ in range(20)]
+    corpus.write_text("\n".join(lines))
+
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.preprocess_data import main as preprocess_main
+
+    out_prefix = str(tmp_path / "out")
+    preprocess_main([
+        "--input", str(corpus), "--output_prefix", out_prefix,
+        "--tokenizer_type", "GPT2BPETokenizer",
+        "--vocab_file", vf, "--merges_file", mf, "--append_eod",
+    ])
+
+    from megatron_llm_tpu.data import MMapIndexedDataset
+
+    ds = MMapIndexedDataset(out_prefix + "_text_document")
+    assert len(ds) == 20
+    tok = build_tokenizer("GPT2BPETokenizer", vocab_file=vf, merges_file=mf)
+    assert ds[0][-1] == tok.eod
+    assert tok.detokenize(ds[0][:-1]) == "hello world hello"

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Merge multiple .bin/.idx datasets into one (ref: tools/merge_datasets.py)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", type=str, required=True,
+                   help="directory containing .bin/.idx pairs to merge")
+    p.add_argument("--output_prefix", type=str, required=True)
+    args = p.parse_args(argv)
+
+    prefixes = sorted(
+        {
+            os.path.join(args.input, f[:-4])
+            for f in os.listdir(args.input)
+            if f.endswith(".bin") or f.endswith(".idx")
+        }
+    )
+    prefixes = [p_ for p_ in prefixes if MMapIndexedDataset.exists(p_)]
+    assert prefixes, f"no .bin/.idx pairs under {args.input}"
+
+    first = MMapIndexedDataset(prefixes[0])
+    dtype = first.dtype
+    first.close()
+
+    builder = MMapIndexedDatasetBuilder(args.output_prefix + ".bin", dtype=dtype)
+    for prefix in prefixes:
+        print(f"merging {prefix}")
+        builder.merge_file_(prefix)
+    builder.finalize(args.output_prefix + ".idx")
+    print(f"done -> {args.output_prefix}.bin/.idx")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Tokenize a JSONL corpus into the .bin/.idx mmap format.
+
+Parity target: ref tools/preprocess_data.py:1-201 — JSONL in, one document
+per line (field per --json_keys), optional EOD append, multiprocessing
+tokenizer pool, MMapIndexedDatasetBuilder out. Output is loadable by both
+this framework and the reference.
+
+Usage:
+  python tools/preprocess_data.py --input corpus.jsonl --output_prefix out \
+      --tokenizer_type GPT2BPETokenizer --vocab_file vocab.json \
+      --merges_file merges.txt --append_eod --workers 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDatasetBuilder,
+    best_fitting_dtype,
+)
+from megatron_llm_tpu.tokenizer import build_tokenizer
+
+_TOKENIZER = None
+_ARGS = None
+
+
+def _init_worker(args):
+    global _TOKENIZER, _ARGS
+    _ARGS = args
+    _TOKENIZER = build_tokenizer(
+        args.tokenizer_type,
+        vocab_file=args.vocab_file,
+        merges_file=args.merges_file,
+        tokenizer_model=args.tokenizer_model,
+        make_vocab_size_divisible_by=args.make_vocab_size_divisible_by,
+        null_vocab_size=args.null_vocab_size,
+    )
+
+
+def _encode(line: str):
+    """ref: Encoder.encode (preprocess_data.py:42-80)."""
+    line = line.strip()
+    if not line:
+        return None, 0
+    data = json.loads(line)
+    out = {}
+    for key in _ARGS.json_keys:
+        text = data[key]
+        ids = _TOKENIZER.tokenize(text)
+        if _ARGS.append_eod and len(ids) > 0:
+            ids.append(_TOKENIZER.eod)
+        out[key] = ids
+    return out, len(line)
+
+
+def get_args(argv=None):
+    p = argparse.ArgumentParser()
+    g = p.add_argument_group("input data")
+    g.add_argument("--input", type=str, required=True)
+    g.add_argument("--json_keys", nargs="+", default=["text"])
+    g = p.add_argument_group("tokenizer")
+    g.add_argument("--tokenizer_type", type=str, required=True)
+    g.add_argument("--vocab_file", type=str, default=None)
+    g.add_argument("--merges_file", type=str, default=None)
+    g.add_argument("--tokenizer_model", type=str, default=None)
+    g.add_argument("--append_eod", action="store_true")
+    g.add_argument("--make_vocab_size_divisible_by", type=int, default=128)
+    g.add_argument("--null_vocab_size", type=int, default=None)
+    g = p.add_argument_group("output data")
+    g.add_argument("--output_prefix", type=str, required=True)
+    g.add_argument("--dataset_impl", type=str, default="mmap", choices=["mmap"])
+    g = p.add_argument_group("runtime")
+    g.add_argument("--workers", type=int, default=1)
+    g.add_argument("--chunk_size", type=int, default=25)
+    g.add_argument("--log_interval", type=int, default=10000)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = get_args(argv)
+    tokenizer = build_tokenizer(
+        args.tokenizer_type,
+        vocab_file=args.vocab_file,
+        merges_file=args.merges_file,
+        tokenizer_model=args.tokenizer_model,
+        make_vocab_size_divisible_by=args.make_vocab_size_divisible_by,
+        null_vocab_size=args.null_vocab_size,
+    )
+    dtype = best_fitting_dtype(tokenizer.padded_vocab_size)
+
+    builders = {
+        key: MMapIndexedDatasetBuilder(
+            f"{args.output_prefix}_{key}_document.bin", dtype=dtype
+        )
+        for key in args.json_keys
+    }
+
+    fin = open(args.input, encoding="utf-8")
+    start = time.time()
+    total_bytes = 0
+    n_docs = 0
+    if args.workers > 1:
+        pool = multiprocessing.Pool(
+            args.workers, initializer=_init_worker, initargs=(args,)
+        )
+        encoded = pool.imap(_encode, fin, args.chunk_size)
+    else:
+        _init_worker(args)
+        encoded = map(_encode, fin)
+
+    for doc, nbytes in encoded:
+        if doc is None:
+            continue
+        total_bytes += nbytes
+        for key, ids in doc.items():
+            if len(ids) == 0:
+                continue
+            builders[key].add_item(np.asarray(ids))
+            builders[key].end_document()
+        n_docs += 1
+        if n_docs % args.log_interval == 0:
+            mb = total_bytes / 1024 / 1024
+            el = time.time() - start
+            print(f"processed {n_docs} documents ({n_docs/el:.1f} docs/s, "
+                  f"{mb/el:.2f} MB/s)", flush=True)
+
+    for key in args.json_keys:
+        builders[key].finalize(f"{args.output_prefix}_{key}_document.idx")
+    print(f"done: {n_docs} documents -> {args.output_prefix}_*_document.bin/.idx")
+
+
+if __name__ == "__main__":
+    main()
