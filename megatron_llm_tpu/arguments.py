@@ -1,0 +1,296 @@
+"""CLI argument surface -> typed configs.
+
+Parity target: ref megatron/arguments.py:14-1075 (17 groups, SURVEY.md
+§2.5). The reference parses into one namespace consumed through a global;
+here `parse_args` maps the same flag names onto (ModelConfig,
+ParallelConfig, TrainConfig, data/tokenizer args) dataclasses. Flags keep
+the reference spelling so shell scripts port unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import (
+    ModelConfig,
+    ParallelConfig,
+    TrainConfig,
+    falcon_config,
+    gpt_config,
+    llama_config,
+)
+
+
+@dataclass
+class DataArgs:
+    data_path: Optional[List[str]] = None
+    split: str = "969,30,1"
+    tokenizer_type: Optional[str] = None
+    vocab_file: Optional[str] = None
+    merges_file: Optional[str] = None
+    tokenizer_model: Optional[str] = None
+    seq_length: int = 2048
+    reset_position_ids: bool = False
+    reset_attention_mask: bool = False
+    eod_mask_loss: bool = False
+    null_vocab_size: Optional[int] = None
+    dataloader_type: str = "single"
+
+
+def build_base_parser() -> argparse.ArgumentParser:
+    """ref: build_base_parser (arguments.py:14-34)."""
+    p = argparse.ArgumentParser(description="megatron_llm_tpu arguments",
+                                allow_abbrev=False)
+    g = p.add_argument_group("network size")  # ref :406-474
+    g.add_argument("--model_name", default="gpt",
+                   choices=["gpt", "llama", "llama2", "codellama", "falcon"])
+    g.add_argument("--model_size", type=int, default=7)
+    g.add_argument("--num_layers", type=int, default=None)
+    g.add_argument("--hidden_size", type=int, default=None)
+    g.add_argument("--ffn_hidden_size", type=int, default=None)
+    g.add_argument("--num_attention_heads", type=int, default=None)
+    g.add_argument("--num_attention_heads_kv", type=int, default=None)
+    g.add_argument("--kv_channels", type=int, default=None)
+    g.add_argument("--max_position_embeddings", type=int, default=None)
+    g.add_argument("--make_vocab_size_divisible_by", type=int, default=128)
+    g.add_argument("--layernorm_epsilon", type=float, default=None)
+    g.add_argument("--use_bias", action="store_true", default=None)
+    g.add_argument("--use_rms_norm", action="store_true", default=None)
+    g.add_argument("--use_post_ln", action="store_true", default=None)
+    g.add_argument("--glu_activation", type=str, default=None)
+    g.add_argument("--position_embedding_type", type=str, default=None)
+    g.add_argument("--rope_scaling_factor", type=float, default=None)
+    g.add_argument("--rope_theta", type=float, default=None)
+    g.add_argument("--parallel_attn", action="store_true", default=None)
+    g.add_argument("--parallel_layernorm", action="store_true", default=None)
+    g.add_argument("--no_tie_embed_logits", action="store_true")
+
+    g = p.add_argument_group("regularization")  # ref :544-574
+    g.add_argument("--hidden_dropout", type=float, default=None)
+    g.add_argument("--attention_dropout", type=float, default=None)
+    g.add_argument("--lima_dropout", action="store_true", default=None)
+    g.add_argument("--weight_decay", type=float, default=0.01)
+    g.add_argument("--start_weight_decay", type=float, default=None)
+    g.add_argument("--end_weight_decay", type=float, default=None)
+    g.add_argument("--weight_decay_incr_style", default="constant")
+    g.add_argument("--clip_grad", type=float, default=1.0)
+    g.add_argument("--adam_beta1", type=float, default=0.9)
+    g.add_argument("--adam_beta2", type=float, default=0.999)
+    g.add_argument("--adam_eps", type=float, default=1e-8)
+    g.add_argument("--sgd_momentum", type=float, default=0.9)
+
+    g = p.add_argument_group("training")  # ref :579-691
+    g.add_argument("--micro_batch_size", type=int, default=1)
+    g.add_argument("--global_batch_size", type=int, default=None)
+    g.add_argument("--rampup_batch_size", nargs=3, type=int, default=None)
+    g.add_argument("--train_iters", type=int, default=None)
+    g.add_argument("--exit_interval", type=int, default=None)
+    g.add_argument("--exit_duration_in_mins", type=float, default=None)
+    g.add_argument("--exit_signal_handler", action="store_true")
+    g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
+    g.add_argument("--dataloader_type", default="single",
+                   choices=["single", "cyclic"])
+    g.add_argument("--use_flash_attn", action="store_true", default=None)
+    g.add_argument("--recompute_granularity", default=None,
+                   choices=[None, "full", "selective"])
+    g.add_argument("--sequence_parallel", action="store_true")
+
+    g = p.add_argument_group("learning rate")  # ref :710-747
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--lr_decay_style", default="linear",
+                   choices=["constant", "linear", "cosine", "inverse-square-root"])
+    g.add_argument("--lr_decay_iters", type=int, default=None)
+    g.add_argument("--lr_warmup_fraction", type=float, default=None)
+    g.add_argument("--lr_warmup_iters", type=int, default=0)
+    g.add_argument("--min_lr", type=float, default=0.0)
+    g.add_argument("--use_checkpoint_opt_param_scheduler", action="store_true")
+    g.add_argument("--override_opt_param_scheduler", action="store_true")
+
+    g = p.add_argument_group("checkpointing")  # ref :751-779
+    g.add_argument("--save", type=str, default=None)
+    g.add_argument("--save_interval", type=int, default=None)
+    g.add_argument("--load", type=str, default=None)
+    g.add_argument("--finetune", action="store_true")
+    g.add_argument("--no_load_optim", action="store_true")
+    g.add_argument("--no_load_rng", action="store_true")
+
+    g = p.add_argument_group("mixed precision")  # ref :783-815
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss_scale", type=float, default=None)
+    g.add_argument("--initial_loss_scale", type=float, default=2.0**32)
+    g.add_argument("--loss_scale_window", type=int, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+
+    g = p.add_argument_group("distributed")  # ref :820-866
+    g.add_argument("--tensor_model_parallel_size", type=int, default=1)
+    g.add_argument("--pipeline_model_parallel_size", type=int, default=1)
+    g.add_argument("--num_layers_per_virtual_pipeline_stage", type=int,
+                   default=None)
+    g.add_argument("--use_distributed_optimizer", action="store_true")
+    g.add_argument("--data_parallel_size", type=int, default=None)
+
+    g = p.add_argument_group("validation")  # ref :870-877
+    g.add_argument("--eval_iters", type=int, default=100)
+    g.add_argument("--eval_interval", type=int, default=1000)
+
+    g = p.add_argument_group("data")  # ref :881-962
+    g.add_argument("--data_path", nargs="*", default=None)
+    g.add_argument("--split", default="969,30,1")
+    g.add_argument("--seq_length", type=int, default=2048)
+    g.add_argument("--tokenizer_type", type=str, default=None)
+    g.add_argument("--vocab_file", type=str, default=None)
+    g.add_argument("--merges_file", type=str, default=None)
+    g.add_argument("--tokenizer_model", type=str, default=None)
+    g.add_argument("--null_vocab_size", type=int, default=None)
+    g.add_argument("--reset_position_ids", action="store_true")
+    g.add_argument("--reset_attention_mask", action="store_true")
+    g.add_argument("--eod_mask_loss", action="store_true")
+    g.add_argument("--seed", type=int, default=1234)
+
+    g = p.add_argument_group("logging")  # ref :477-541
+    g.add_argument("--log_interval", type=int, default=100)
+    g.add_argument("--tensorboard_dir", type=str, default=None)
+    g.add_argument("--wandb_logger", action="store_true")
+
+    return p
+
+
+def args_to_configs(args, padded_vocab_size: int):
+    """Map the parsed namespace onto typed configs (the reference's
+    validate_args derivations, arguments.py:52-345)."""
+    tp = args.tensor_model_parallel_size
+    pp = args.pipeline_model_parallel_size
+
+    overrides = {}
+    for name in (
+        "num_layers", "hidden_size", "ffn_hidden_size", "num_attention_heads",
+        "num_attention_heads_kv", "kv_channels", "layernorm_epsilon",
+        "glu_activation", "position_embedding_type", "rope_scaling_factor",
+        "rope_theta", "hidden_dropout", "attention_dropout", "lima_dropout",
+        "use_flash_attn", "recompute_granularity", "use_bias", "use_rms_norm",
+        "use_post_ln", "parallel_attn", "parallel_layernorm",
+    ):
+        v = getattr(args, name)
+        if v is not None:
+            overrides[name] = v
+    if args.max_position_embeddings is not None:
+        overrides["max_position_embeddings"] = args.max_position_embeddings
+    else:
+        overrides["max_position_embeddings"] = args.seq_length
+    overrides["make_vocab_size_divisible_by"] = args.make_vocab_size_divisible_by
+    if args.no_tie_embed_logits:
+        overrides["tie_embed_logits"] = False
+    if args.fp16:
+        overrides["params_dtype"] = jnp.float32
+        overrides["compute_dtype"] = jnp.float16
+
+    name = args.model_name
+    if name in ("llama", "llama2"):
+        mcfg = llama_config(args.model_size, version=1 if name == "llama" else 2,
+                            seq_length=args.seq_length, tp=tp, **overrides)
+    elif name == "codellama":
+        from megatron_llm_tpu.config import codellama_config
+
+        mcfg = codellama_config(args.model_size, seq_length=args.seq_length,
+                                **overrides)
+    elif name == "falcon":
+        mcfg = falcon_config(args.model_size, seq_length=args.seq_length,
+                             tp=tp, **overrides)
+    else:
+        mcfg = gpt_config(
+            num_layers=overrides.pop("num_layers", 12),
+            hidden_size=overrides.pop("hidden_size", 768),
+            num_attention_heads=overrides.pop("num_attention_heads", 12),
+            seq_length=args.seq_length,
+            tp=tp,
+            **overrides,
+        )
+    import dataclasses as _dc
+
+    mcfg = _dc.replace(mcfg, padded_vocab_size=mcfg.pad_vocab_size(
+        padded_vocab_size, tp) if padded_vocab_size else mcfg.padded_vocab_size)
+
+    import jax
+
+    dp = args.data_parallel_size
+    if dp is None:
+        dp = max(1, len(jax.devices()) // (tp * pp))
+    gbs = args.global_batch_size or args.micro_batch_size * dp
+    num_micro = gbs // (args.micro_batch_size * dp)
+    pcfg = ParallelConfig(
+        data_parallel_size=dp,
+        pipeline_parallel_size=pp,
+        tensor_parallel_size=tp,
+        virtual_pipeline_parallel_size=args.num_layers_per_virtual_pipeline_stage,
+        sequence_parallel=args.sequence_parallel,
+        use_distributed_optimizer=args.use_distributed_optimizer,
+        num_microbatches=num_micro,
+    )
+
+    tcfg = TrainConfig(
+        micro_batch_size=args.micro_batch_size,
+        global_batch_size=gbs,
+        rampup_batch_size=tuple(args.rampup_batch_size)
+        if args.rampup_batch_size else None,
+        train_iters=args.train_iters,
+        exit_interval=args.exit_interval,
+        exit_duration_in_mins=args.exit_duration_in_mins,
+        exit_signal_handler=args.exit_signal_handler,
+        optimizer=args.optimizer,
+        lr=args.lr,
+        min_lr=args.min_lr,
+        lr_decay_style=args.lr_decay_style,
+        lr_decay_iters=args.lr_decay_iters,
+        lr_warmup_iters=args.lr_warmup_iters,
+        lr_warmup_fraction=args.lr_warmup_fraction,
+        use_checkpoint_opt_param_scheduler=args.use_checkpoint_opt_param_scheduler,
+        override_opt_param_scheduler=args.override_opt_param_scheduler,
+        weight_decay=args.weight_decay,
+        start_weight_decay=args.start_weight_decay,
+        end_weight_decay=args.end_weight_decay,
+        weight_decay_incr_style=args.weight_decay_incr_style,
+        clip_grad=args.clip_grad,
+        adam_beta1=args.adam_beta1,
+        adam_beta2=args.adam_beta2,
+        adam_eps=args.adam_eps,
+        sgd_momentum=args.sgd_momentum,
+        fp16=args.fp16,
+        bf16=not args.fp16,
+        loss_scale=args.loss_scale,
+        initial_loss_scale=args.initial_loss_scale,
+        loss_scale_window=args.loss_scale_window,
+        hysteresis=args.hysteresis,
+        save=args.save,
+        load=args.load,
+        save_interval=args.save_interval,
+        finetune=args.finetune,
+        no_load_optim=args.no_load_optim,
+        no_load_rng=args.no_load_rng,
+        log_interval=args.log_interval,
+        eval_interval=args.eval_interval,
+        eval_iters=args.eval_iters,
+        tensorboard_dir=args.tensorboard_dir,
+        wandb_logger=args.wandb_logger,
+        seed=args.seed,
+    )
+
+    dargs = DataArgs(
+        data_path=args.data_path,
+        split=args.split,
+        tokenizer_type=args.tokenizer_type,
+        vocab_file=args.vocab_file,
+        merges_file=args.merges_file,
+        tokenizer_model=args.tokenizer_model,
+        seq_length=args.seq_length,
+        reset_position_ids=args.reset_position_ids,
+        reset_attention_mask=args.reset_attention_mask,
+        eod_mask_loss=args.eod_mask_loss,
+        null_vocab_size=args.null_vocab_size,
+        dataloader_type=args.dataloader_type,
+    )
+    return mcfg, pcfg, tcfg, dargs

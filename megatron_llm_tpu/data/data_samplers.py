@@ -111,17 +111,22 @@ class PretrainingDataLoader:
     it swappable for a background-thread prefetcher.
     """
 
-    def __init__(self, dataset, sampler, num_microbatches: int = 1):
+    def __init__(self, dataset, sampler, num_microbatches=1):
         self.dataset = dataset
         self.sampler = sampler
+        # int, or a zero-arg callable consulted each step — that's how the
+        # batch-size rampup reaches the loader (ref: the reference re-reads
+        # get_num_microbatches() every train_step, training.py:403).
         self.num_microbatches = num_microbatches
 
     def __iter__(self):
         it = iter(self.sampler)
         while True:
+            n = self.num_microbatches() if callable(self.num_microbatches) \
+                else self.num_microbatches
             micros = []
             try:
-                for _ in range(self.num_microbatches):
+                for _ in range(n):
                     idxs = next(it)
                     micros.append(
                         np.stack([self.dataset[i]["text"] for i in idxs]).astype(
@@ -138,7 +143,7 @@ def build_pretraining_data_loader(
     consumed_samples: int,
     micro_batch_size: int,
     data_parallel_size: int,
-    num_microbatches: int = 1,
+    num_microbatches=1,  # int or zero-arg callable (rampup)
     dataloader_type: str = "single",
     drop_last: bool = True,
 ):

@@ -62,10 +62,12 @@ class DynamicGradScaler:
         return state["scale"]
 
     def update(self, state, found_inf):
-        """Pure-functional form of ref grad_scaler.py:85-116: on overflow the
-        hysteresis tracker decrements (clean steps do NOT replenish it) and
-        the scale backs off once it reaches zero; `growth_interval`
-        consecutive clean steps grow the scale and restore the tracker."""
+        """Pure-functional form of ref grad_scaler.py:86-106, exactly:
+        overflow zeroes the growth tracker and decrements hysteresis; once
+        hysteresis <= 0 EVERY further overflow backs the scale off (the
+        tracker is NOT reset by backoff); only a growth event —
+        `growth_interval` consecutive clean steps — restores hysteresis and
+        grows the scale."""
         found_inf = found_inf.astype(bool)
         hyst = jnp.where(
             found_inf, state["hysteresis_tracker"] - 1, state["hysteresis_tracker"]
@@ -76,9 +78,8 @@ class DynamicGradScaler:
             jnp.maximum(state["scale"] * self.backoff_factor, self.min_scale),
             state["scale"],
         )
-        hyst = jnp.where(backoff, jnp.int32(self.hysteresis), hyst)
         growth = jnp.where(found_inf, 0, state["growth_tracker"] + 1)
-        grow = growth == self.growth_interval
+        grow = ~found_inf & (growth == self.growth_interval)
         new_scale = jnp.where(grow, new_scale * self.growth_factor, new_scale)
         growth = jnp.where(grow, 0, growth)
         hyst = jnp.where(grow, jnp.int32(self.hysteresis), hyst)

@@ -145,10 +145,40 @@ class _SentencePieceTokenizer(AbstractTokenizer):
         return self._inv_vocab
 
     def tokenize(self, text):
-        return self.tokenizer.encode(text)
+        """Split on added special tokens first (ref: tokenizer.py:406-434 —
+        the reference's added tokens are matched before SP encoding)."""
+        if not self._special_tokens:
+            return self.tokenizer.encode(text)
+        ids: list = []
+        rest = text
+        specials = sorted(self._special_tokens, key=len, reverse=True)
+        while rest:
+            positions = [(rest.find(t), t) for t in specials if rest.find(t) >= 0]
+            if not positions:
+                ids.extend(self.tokenizer.encode(rest))
+                break
+            pos, tok = min(positions)
+            if pos > 0:
+                ids.extend(self.tokenizer.encode(rest[:pos]))
+            ids.append(self._special_tokens[tok])
+            rest = rest[pos + len(tok):]
+        return ids
 
     def detokenize(self, token_ids):
-        return self.tokenizer.decode([int(t) for t in token_ids])
+        """Decode runs of SP ids, splicing added-special-token strings."""
+        base = self.tokenizer.get_piece_size()
+        out, run = [], []
+        for t in (int(t) for t in token_ids):
+            if t >= base:
+                if run:
+                    out.append(self.tokenizer.decode(run))
+                    run = []
+                out.append(self._inv_vocab[t])
+            else:
+                run.append(t)
+        if run:
+            out.append(self.tokenizer.decode(run))
+        return "".join(out)
 
     @property
     def bos(self):

@@ -1,0 +1,170 @@
+"""Checkpoint save/load — orbax/tensorstore, mesh-shape independent.
+
+Parity target: ref megatron/checkpointing.py — iteration-numbered
+directories, a `latest_checkpointed_iteration.txt` tracker (:170),
+`--finetune` semantics (reset iteration, skip optim/rng, :583-625),
+arg cross-checking (:35-66), rng state for bitwise resume (:217-240).
+
+TPU-first differences: one orbax checkpoint holds the whole (sharded)
+params/optimizer tree keyed by logical names — tensorstore reshards on load
+under any mesh shape, which deletes the entire reason the reference needs
+tools/checkpoint_util.py's tp/pp re-partitioner (SURVEY.md §5). Layout:
+
+    <save>/iter_0000100/{model,optim,meta}   (orbax composite)
+    <save>/latest_checkpointed_iteration.txt
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+TRACKER_FILENAME = "latest_checkpointed_iteration.txt"
+
+
+def checkpoint_dir(save_dir: str, iteration: int, release: bool = False) -> str:
+    """ref: get_checkpoint_name (checkpointing.py:77-96) directory level."""
+    name = "release" if release else f"iter_{iteration:07d}"
+    return os.path.join(save_dir, name)
+
+
+def read_tracker(load_dir: str) -> Tuple[Optional[int], bool]:
+    """ref: read_metadata (checkpointing.py:160-216)."""
+    path = os.path.join(load_dir, TRACKER_FILENAME)
+    if not os.path.isfile(path):
+        return None, False
+    with open(path) as f:
+        raw = f.read().strip()
+    if raw == "release":
+        return None, True
+    return int(raw), False
+
+
+def _write_tracker(save_dir: str, iteration: int) -> None:
+    with open(os.path.join(save_dir, TRACKER_FILENAME), "w") as f:
+        f.write(str(iteration))
+
+
+def _config_meta(model_cfg) -> dict:
+    d = dataclasses.asdict(model_cfg)
+    return {k: (str(v) if not isinstance(v, (int, float, bool, str, type(None), list, tuple)) else v)
+            for k, v in d.items()}
+
+
+def check_checkpoint_args(saved: dict, model_cfg) -> None:
+    """ref: check_checkpoint_args (checkpointing.py:35-66) — error on
+    architecture mismatch."""
+    current = _config_meta(model_cfg)
+    critical = (
+        "num_layers", "hidden_size", "num_attention_heads",
+        "num_attention_heads_kv", "ffn_hidden_size", "padded_vocab_size",
+        "position_embedding_type", "glu_activation", "use_rms_norm",
+        "use_bias", "tie_embed_logits", "parallel_attn", "parallel_layernorm",
+    )
+    for k in critical:
+        if k in saved and saved[k] != current[k]:
+            raise ValueError(
+                f"checkpoint/config mismatch for {k}: "
+                f"checkpoint has {saved[k]!r}, config has {current[k]!r}"
+            )
+
+
+def save_checkpoint(
+    save_dir: str,
+    iteration: int,
+    params: Any,
+    opt_state: Any = None,
+    model_cfg=None,
+    scheduler_state: Optional[dict] = None,
+    consumed_train_samples: int = 0,
+    rng_key: Optional[jax.Array] = None,
+    extra_meta: Optional[dict] = None,
+) -> str:
+    """ref: save_checkpoint (checkpointing.py:243-338)."""
+    path = checkpoint_dir(save_dir, iteration)
+    os.makedirs(save_dir, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "model"), params, force=True)
+    if opt_state is not None:
+        ckptr.save(
+            os.path.join(path, "optim"),
+            {"step": opt_state.step, "m": opt_state.m,
+             **({"v": opt_state.v} if opt_state.v is not None else {})},
+            force=True,
+        )
+    meta = {
+        "iteration": iteration,
+        "consumed_train_samples": consumed_train_samples,
+        "scheduler": scheduler_state or {},
+        "config": _config_meta(model_cfg) if model_cfg is not None else {},
+        "rng_key": np.asarray(jax.random.key_data(rng_key)).tolist()
+        if rng_key is not None else None,
+        "checkpoint_version": 3.0,
+    }
+    meta.update(extra_meta or {})
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    ckptr.wait_until_finished()
+    _write_tracker(save_dir, iteration)
+    return path
+
+
+def load_checkpoint(
+    load_dir: str,
+    params_template: Any,
+    opt_state_template: Any = None,
+    model_cfg=None,
+    finetune: bool = False,
+    no_load_optim: bool = False,
+    no_load_rng: bool = False,
+    iteration: Optional[int] = None,
+):
+    """ref: load_checkpoint (checkpointing.py:561-730).
+
+    Templates are abstract (jax.eval_shape / ShapeDtypeStruct with sharding)
+    or concrete trees; orbax restores into the template's shardings, so the
+    same checkpoint loads under any mesh. Returns
+    (params, opt_state|None, meta, iteration).
+    """
+    if iteration is None:
+        iteration, release = read_tracker(load_dir)
+        if iteration is None and not release:
+            return None  # no checkpoint (ref returns 0 + warns)
+        path = checkpoint_dir(load_dir, iteration or 0, release=release)
+    else:
+        path = checkpoint_dir(load_dir, iteration)
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if model_cfg is not None and meta.get("config"):
+        check_checkpoint_args(meta["config"], model_cfg)
+
+    ckptr = ocp.StandardCheckpointer()
+    abstract_params = jax.tree.map(ocp.utils.to_shape_dtype_struct, params_template)
+    params = ckptr.restore(os.path.join(path, "model"), abstract_params)
+
+    opt_state = None
+    if opt_state_template is not None and not finetune and not no_load_optim:
+        from megatron_llm_tpu.optimizer.optimizer import OptimizerState
+
+        tmpl = {"step": opt_state_template.step, "m": opt_state_template.m}
+        if opt_state_template.v is not None:
+            tmpl["v"] = opt_state_template.v
+        abstract_opt = jax.tree.map(ocp.utils.to_shape_dtype_struct, tmpl)
+        restored = ckptr.restore(os.path.join(path, "optim"), abstract_opt)
+        opt_state = OptimizerState(
+            step=restored["step"], m=restored["m"], v=restored.get("v")
+        )
+
+    # --finetune resets iteration and skips optim/rng (ref :583-625)
+    out_iteration = 0 if finetune else meta["iteration"]
+    if finetune or no_load_rng:
+        meta = dict(meta)
+        meta["rng_key"] = None
+    return params, opt_state, meta, out_iteration

@@ -45,6 +45,7 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
             micro["labels"],
             loss_mask=micro.get("loss_mask"),
             position_ids=micro.get("position_ids"),
+            attention_mask=micro.get("attention_mask"),
             dropout_rng=rng,
             deterministic=rng is None,
         )

@@ -1,0 +1,425 @@
+"""The training runtime: setup + train loop.
+
+Parity target: ref megatron/training.py — `pretrain` (:54), model/optimizer
+setup (:197-390), `_train` loop (:639-752) with logging (:452-626), eval
+(:754-853), save-interval / signal / duration exits, and data-iterator
+construction with consumed-samples resume (:855-939).
+
+Single-controller JAX structure: one process drives the whole mesh; the
+"data iterator broadcast" machinery of the reference (tp-rank-0 loads,
+broadcast to others, training.py:871-915) disappears — the host feeds
+globally-sharded batches directly.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.config import ModelConfig, ParallelConfig, TrainConfig
+from megatron_llm_tpu.optimizer import (
+    OptimizerParamScheduler,
+    init_optimizer_state,
+)
+from megatron_llm_tpu.optimizer.optimizer import OptimizerState
+from megatron_llm_tpu.parallel.mesh import get_context
+from megatron_llm_tpu.parallel.sharding import (
+    optimizer_state_specs,
+    param_specs,
+)
+from megatron_llm_tpu.training.checkpointing import load_checkpoint, save_checkpoint
+from megatron_llm_tpu.training.microbatches import build_num_microbatches_calculator
+from megatron_llm_tpu.training.timers import Timers
+from megatron_llm_tpu.training.train_step import make_train_step
+from megatron_llm_tpu.utils.masks import get_ltor_masks_and_position_ids
+
+
+class SignalHandler:
+    """ref: dist_signal_handler.py:50-80 — latch SIGTERM, checkpoint+exit."""
+
+    def __init__(self, sig=_signal.SIGTERM):
+        self.triggered = False
+        try:
+            self._prev = _signal.signal(sig, self._handle)
+        except ValueError:  # not main thread
+            self._prev = None
+
+    def _handle(self, signum, frame):
+        self.triggered = True
+
+    def signals_received(self) -> bool:
+        return self.triggered
+
+
+def get_batch(text: np.ndarray, eod_token=None, reset_position_ids=False,
+              reset_attention_mask=False, eod_mask_loss=False):
+    """(num_micro, b, seq+1) 'text' -> model inputs
+    (ref: finetune.py get_batch :65-81 + utils.get_ltor_masks_and_position_ids)."""
+    tokens = text[:, :, :-1]
+    labels = text[:, :, 1:]
+    n, b, s = tokens.shape
+    flat = tokens.reshape(n * b, s)
+    attn_mask, loss_mask, position_ids = get_ltor_masks_and_position_ids(
+        jnp.asarray(flat), eod_token, reset_position_ids,
+        reset_attention_mask, eod_mask_loss,
+    )
+    batch = {
+        "tokens": jnp.asarray(tokens),
+        "labels": jnp.asarray(labels),
+        "loss_mask": loss_mask.reshape(n, b, s),
+        "position_ids": position_ids.reshape(n, b, s),
+    }
+    if attn_mask is not None:
+        batch["attention_mask"] = attn_mask.reshape(n, b, 1, s, s)
+    return batch
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: OptimizerState
+    iteration: int = 0
+    consumed_train_samples: int = 0
+
+
+class Trainer:
+    """Owns setup + the loop. `pretrain()` below is the one-call form."""
+
+    def __init__(
+        self,
+        model,
+        tcfg: TrainConfig,
+        pcfg: ParallelConfig,
+        train_data_iterator: Optional[Iterable] = None,
+        valid_data_iterator: Optional[Iterable] = None,
+        eod_token: Optional[int] = None,
+        reset_position_ids: bool = False,
+        reset_attention_mask: bool = False,
+        eod_mask_loss: bool = False,
+    ):
+        self.model = model
+        self.cfg: ModelConfig = model.cfg
+        self.tcfg = tcfg
+        self.pcfg = pcfg
+        self.train_data_iterator = train_data_iterator
+        self.valid_data_iterator = valid_data_iterator
+        self.eod_token = eod_token
+        self.reset_position_ids = reset_position_ids
+        self.reset_attention_mask = reset_attention_mask
+        self.eod_mask_loss = eod_mask_loss
+        self.timers = Timers()
+        self.ctx = get_context()
+        self._eval_step_fn = None
+
+        self.num_microbatches_calc = build_num_microbatches_calculator(
+            tcfg.global_batch_size,
+            tcfg.micro_batch_size,
+            pcfg.data_parallel_size,
+            tcfg.rampup_batch_size,
+        )
+
+        decay_steps = tcfg.lr_decay_iters or tcfg.train_iters
+        warmup = tcfg.lr_warmup_iters
+        if tcfg.lr_warmup_fraction is not None and decay_steps:
+            # ref: validate_args derives warmup from the effective decay span
+            warmup = int(tcfg.lr_warmup_fraction * decay_steps)
+        self.scheduler = OptimizerParamScheduler(
+            max_lr=tcfg.lr,
+            min_lr=tcfg.min_lr,
+            lr_warmup_steps=warmup,
+            lr_decay_steps=decay_steps,
+            lr_decay_style=tcfg.lr_decay_style,
+            start_wd=tcfg.start_weight_decay
+            if tcfg.start_weight_decay is not None else tcfg.weight_decay,
+            end_wd=tcfg.end_weight_decay
+            if tcfg.end_weight_decay is not None else tcfg.weight_decay,
+            wd_incr_steps=tcfg.train_iters,
+            wd_incr_style=tcfg.weight_decay_incr_style,
+            use_checkpoint_opt_param_scheduler=tcfg.use_checkpoint_opt_param_scheduler,
+            override_opt_param_scheduler=tcfg.override_opt_param_scheduler,
+        )
+        self.signal_handler = (
+            SignalHandler() if tcfg.exit_signal_handler else None
+        )
+        self._train_steps: dict = {}  # num_microbatches -> jitted step
+        self._tb_writer = None
+        if tcfg.tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb_writer = SummaryWriter(tcfg.tensorboard_dir)
+            except Exception:
+                self._tb_writer = None
+        if tcfg.wandb_logger:
+            try:
+                from megatron_llm_tpu.training.wandb_logger import WandbTBShim
+
+                self._tb_writer = WandbTBShim(self._tb_writer)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    def setup(self, rng: Optional[jax.Array] = None) -> TrainState:
+        """Build (sharded) params + optimizer state; resume from checkpoint
+        (ref: _setup_model_and_optimizer training.py:351-390)."""
+        rng = rng if rng is not None else jax.random.key(self.tcfg.seed)
+        self.timers("model-and-optimizer-setup").start()
+        if self.ctx is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self.ctx.mesh
+            tmpl = jax.eval_shape(self.model.init, rng)
+            pspecs = param_specs(self.cfg, tmpl)
+            psh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                               is_leaf=lambda x: isinstance(x, P))
+            params = jax.jit(self.model.init, out_shardings=psh)(rng)
+            ospecs = optimizer_state_specs(
+                self.cfg, tmpl, self.pcfg.data_parallel_size,
+                self.pcfg.use_distributed_optimizer,
+            )
+            osh = jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs,
+                               is_leaf=lambda x: isinstance(x, P))
+            opt_state = jax.jit(
+                lambda p: init_optimizer_state(p, self.tcfg),
+                out_shardings=OptimizerState(
+                    step=NamedSharding(mesh, P()), m=osh, v=osh),
+            )(params)
+        else:
+            params = self.model.init(rng)
+            opt_state = init_optimizer_state(params, self.tcfg)
+        self.timers("model-and-optimizer-setup").stop()
+
+        state = TrainState(params=params, opt_state=opt_state)
+        if self.tcfg.load:
+            loaded = load_checkpoint(
+                self.tcfg.load, params, opt_state, self.cfg,
+                finetune=self.tcfg.finetune,
+                no_load_optim=self.tcfg.no_load_optim,
+                no_load_rng=self.tcfg.no_load_rng,
+            )
+            if loaded is not None:
+                params, opt_state_l, meta, iteration = loaded
+                state = TrainState(
+                    params=params,
+                    opt_state=opt_state_l if opt_state_l is not None else opt_state,
+                    iteration=iteration,
+                    consumed_train_samples=0 if self.tcfg.finetune
+                    else meta.get("consumed_train_samples", 0),
+                )
+                if meta.get("scheduler") and not self.tcfg.finetune:
+                    self.scheduler.load_state_dict(meta["scheduler"])
+                print(f"loaded checkpoint from {self.tcfg.load} at iteration "
+                      f"{state.iteration}", flush=True)
+        return state
+
+    def _get_step_fn(self, num_microbatches: int):
+        if num_microbatches not in self._train_steps:
+            import dataclasses as _dc
+
+            pcfg = _dc.replace(self.pcfg, num_microbatches=num_microbatches)
+            self._train_steps[num_microbatches] = jax.jit(
+                make_train_step(self.model, self.tcfg, pcfg),
+                donate_argnums=(0, 1),
+            )
+        return self._train_steps[num_microbatches]
+
+    # ------------------------------------------------------------------
+    def train_step(self, state: TrainState, text: np.ndarray, dropout_rng=None):
+        """One optimizer step over a global batch 'text'
+        (num_micro, mbs*dp, seq+1) (ref: train_step training.py:391-450)."""
+        num_micro = text.shape[0]
+        batch = get_batch(
+            text, self.eod_token, self.reset_position_ids,
+            self.reset_attention_mask, self.eod_mask_loss,
+        )
+        lr, wd = self.scheduler.get_lr(), self.scheduler.get_wd()
+        step_fn = self._get_step_fn(num_micro)
+        params, opt_state, stats = step_fn(
+            state.params, state.opt_state, batch,
+            jnp.float32(lr), jnp.float32(wd), dropout_rng,
+        )
+        self.scheduler.step()
+        state.params = params
+        state.opt_state = opt_state
+        state.iteration += 1
+        state.consumed_train_samples += num_micro * text.shape[1]
+        self.num_microbatches_calc.update(state.consumed_train_samples)
+        stats["lr"] = lr
+        stats["batch_size"] = num_micro * text.shape[1]
+        return stats
+
+    def evaluate(self, state: TrainState, max_iters: Optional[int] = None) -> float:
+        """ref: evaluate (training.py:754-853)."""
+        if self.valid_data_iterator is None:
+            return float("nan")
+        if self._eval_step_fn is None:
+            from megatron_llm_tpu.training.train_step import make_eval_step
+
+            self._eval_step_fn = jax.jit(make_eval_step(self.model))
+        eval_step = self._eval_step_fn
+        total, count = 0.0, 0
+        iters = max_iters if max_iters is not None else self.tcfg.eval_iters
+        it = iter(self.valid_data_iterator)
+        for _ in range(iters):
+            try:
+                text = next(it)
+            except StopIteration:
+                break
+            batch = get_batch(text, self.eod_token)
+            micro = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), batch)
+            total += float(eval_step(state.params, micro))
+            count += 1
+        return total / max(count, 1)
+
+    # ------------------------------------------------------------------
+    def _training_log(self, state: TrainState, stats: dict, elapsed: float):
+        """ref: training_log (training.py:452-626)."""
+        loss = float(stats["loss"])
+        gnorm = float(stats["grad_norm"])
+        line = (
+            f"iteration {state.iteration:8d}/{self.tcfg.train_iters or 0:8d} | "
+            f"consumed samples: {state.consumed_train_samples:12d} | "
+            f"elapsed time per iteration (ms): {elapsed*1000:.1f} | "
+            f"learning rate: {stats['lr']:.3E} | "
+            f"global batch size: {stats['batch_size']:5d} | "
+            f"lm loss: {loss:.6E} | grad norm: {gnorm:.3f} | "
+            f"skipped iterations: {int(stats['skipped'])}"
+        )
+        print(line, flush=True)
+        if self._tb_writer is not None:
+            w = self._tb_writer
+            it = state.iteration
+            w.add_scalar("lm-loss", loss, it)
+            w.add_scalar("learning-rate", stats["lr"], it)
+            w.add_scalar("grad-norm", gnorm, it)
+            w.add_scalar("batch-size", stats["batch_size"], it)
+            if hasattr(w, "flush"):
+                # ref: flush_all batching (training.py:706-708)
+                w.flush()
+
+    def _save(self, state: TrainState):
+        if not self.tcfg.save:
+            return
+        self.timers("save-checkpoint").start()
+        save_checkpoint(
+            self.tcfg.save, state.iteration, state.params, state.opt_state,
+            self.cfg, self.scheduler.state_dict(), state.consumed_train_samples,
+        )
+        self.timers("save-checkpoint").stop()
+        print(f"saved checkpoint at iteration {state.iteration} to "
+              f"{self.tcfg.save}", flush=True)
+
+    def train(self, state: TrainState) -> TrainState:
+        """The loop (ref: _train training.py:639-752)."""
+        tcfg = self.tcfg
+        assert self.train_data_iterator is not None
+        data_iter = iter(self.train_data_iterator)
+        start_time = time.time()
+        dropout_rng = None
+        if self.cfg.hidden_dropout > 0 or self.cfg.attention_dropout > 0:
+            dropout_rng = jax.random.key(tcfg.seed + 1)
+
+        last_log_time = time.time()
+        while tcfg.train_iters is None or state.iteration < tcfg.train_iters:
+            try:
+                text = next(data_iter)
+            except StopIteration:
+                print("data iterator exhausted", flush=True)
+                break
+            step_rng = None
+            if dropout_rng is not None:
+                step_rng = jax.random.fold_in(dropout_rng, state.iteration)
+            t0 = time.time()
+            stats = self.train_step(state, text, step_rng)
+            loss_val = float(stats["loss"])  # host sync (axon: the real barrier)
+            stats["loss"] = loss_val
+            elapsed = time.time() - t0
+
+            if state.iteration % tcfg.log_interval == 0:
+                self._training_log(state, stats, elapsed)
+
+            if (
+                tcfg.eval_interval
+                and self.valid_data_iterator is not None
+                and state.iteration % tcfg.eval_interval == 0
+            ):
+                val = self.evaluate(state)
+                ppl = float(np.exp(min(20.0, val)))
+                print(f"validation loss at iteration {state.iteration}: "
+                      f"{val:.6E} | ppl: {ppl:.4f}", flush=True)
+
+            if tcfg.save_interval and state.iteration % tcfg.save_interval == 0:
+                self._save(state)
+
+            # exit conditions (ref: training.py:712-748)
+            if self.signal_handler is not None and self.signal_handler.signals_received():
+                print("exiting on termination signal", flush=True)
+                self._save(state)
+                break
+            if tcfg.exit_duration_in_mins is not None:
+                if (time.time() - start_time) / 60.0 > tcfg.exit_duration_in_mins:
+                    print("exiting on duration limit", flush=True)
+                    self._save(state)
+                    break
+            if tcfg.exit_interval and state.iteration % tcfg.exit_interval == 0:
+                print(f"exiting at iteration {state.iteration}", flush=True)
+                break
+        return state
+
+
+def pretrain(
+    model,
+    tcfg: TrainConfig,
+    pcfg: ParallelConfig,
+    train_valid_test_dataset_provider: Callable,
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+) -> TrainState:
+    """One-call training entry (ref: pretrain training.py:54-196).
+
+    `train_valid_test_dataset_provider(train_val_test_num_samples)` returns
+    (train_ds, valid_ds, test_ds) with __len__/__getitem__->{'text'}.
+    """
+    from megatron_llm_tpu.data.data_samplers import build_pretraining_data_loader
+
+    train_iters = tcfg.train_iters or 0
+    eval_iters = (train_iters // max(tcfg.eval_interval, 1) + 1) * tcfg.eval_iters
+    num_samples = [
+        train_iters * tcfg.global_batch_size,
+        eval_iters * tcfg.global_batch_size,
+        tcfg.eval_iters * tcfg.global_batch_size,
+    ]
+    train_ds, valid_ds, test_ds = train_valid_test_dataset_provider(num_samples)
+
+    trainer = Trainer(
+        model, tcfg, pcfg, eod_token=eod_token,
+        reset_position_ids=reset_position_ids,
+        reset_attention_mask=reset_attention_mask,
+        eod_mask_loss=eod_mask_loss,
+    )
+    state = trainer.setup()
+
+    # the trainer's calculator is the single source of the current batch
+    # size; the loader consults it live so --rampup_batch_size ramps
+    # (ref: training.py:403 re-reads get_num_microbatches() every step)
+    trainer.train_data_iterator = build_pretraining_data_loader(
+        train_ds, state.consumed_train_samples, tcfg.micro_batch_size,
+        pcfg.data_parallel_size, trainer.num_microbatches_calc.get,
+    )
+    trainer.valid_data_iterator = build_pretraining_data_loader(
+        valid_ds, 0, tcfg.micro_batch_size, pcfg.data_parallel_size, 1,
+    )
+
+    state = trainer.train(state)
+    if tcfg.save:
+        trainer._save(state)
+    return state

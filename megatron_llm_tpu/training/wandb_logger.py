@@ -1,0 +1,56 @@
+"""WandB logging shim (ref: megatron/wandb_logger.py:13-172).
+
+Duck-types the tensorboard SummaryWriter interface (`add_scalar`,
+`add_text`, `flush`) so the trainer logs to either or both; batches values
+and flushes on demand like the reference's `flush_all` (training.py:706-708).
+Gated: if wandb isn't importable or configured, becomes a no-op.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class WandBConfig:
+    """ref: WandBConfig (wandb_logger.py:13-40)."""
+
+    project: str = "megatron_llm_tpu"
+    name: Optional[str] = None
+    entity: Optional[str] = None
+    mode: str = "offline"
+
+
+class WandbTBShim:
+    def __init__(self, tb_writer=None, config: Optional[WandBConfig] = None):
+        self._tb = tb_writer
+        self._pending: dict = {}
+        self._run = None
+        cfg = config or WandBConfig()
+        try:
+            import wandb
+
+            self._run = wandb.init(
+                project=cfg.project, name=cfg.name, entity=cfg.entity,
+                mode=cfg.mode,
+            )
+        except Exception:
+            self._run = None
+
+    def add_scalar(self, name: str, value, iteration: int):
+        if self._tb is not None:
+            self._tb.add_scalar(name, value, iteration)
+        self._pending.setdefault(iteration, {})[name] = value
+
+    def add_text(self, name: str, text: str, iteration: int = 0):
+        if self._tb is not None:
+            self._tb.add_text(name, text, iteration)
+
+    def flush(self):
+        if self._run is not None:
+            for it in sorted(self._pending):
+                self._run.log(self._pending[it], step=it)
+        self._pending.clear()
+        if self._tb is not None:
+            self._tb.flush()

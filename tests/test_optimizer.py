@@ -15,8 +15,9 @@ from megatron_llm_tpu.optimizer.scheduler import OptimizerParamScheduler
 
 
 def test_dynamic_scaler_hysteresis():
-    """ref grad_scaler.py:85-106: clean steps do NOT replenish hysteresis;
-    isolated overflows accumulate toward backoff."""
+    """ref grad_scaler.py:86-106: clean steps do NOT replenish hysteresis;
+    once exhausted, EVERY further overflow backs off (no reset on backoff);
+    only a growth event restores the tracker."""
     sc = DynamicGradScaler(initial_scale=1024.0, hysteresis=2, growth_interval=1000)
     st = sc.init_state()
     inf, ok = jnp.bool_(True), jnp.bool_(False)
@@ -24,9 +25,11 @@ def test_dynamic_scaler_hysteresis():
     assert float(st["scale"]) == 1024.0 and int(st["hysteresis_tracker"]) == 1
     st = sc.update(st, ok)  # clean step must NOT reset tracker
     assert int(st["hysteresis_tracker"]) == 1
-    st = sc.update(st, inf)  # tracker -> 0 => backoff + reset
+    st = sc.update(st, inf)  # tracker -> 0 => backoff, tracker stays 0
     assert float(st["scale"]) == 512.0
-    assert int(st["hysteresis_tracker"]) == 2
+    assert int(st["hysteresis_tracker"]) == 0
+    st = sc.update(st, inf)  # exhausted: every overflow now backs off
+    assert float(st["scale"]) == 256.0
 
 
 def test_dynamic_scaler_growth():

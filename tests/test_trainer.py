@@ -1,0 +1,143 @@
+"""Trainer runtime tests: checkpoint roundtrip, resume semantics,
+microbatch calculators, timers (ref analogues: checkpointing.py,
+microbatches.py, timers.py contracts)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig, tiny_config
+from megatron_llm_tpu.models import LlamaModel
+from megatron_llm_tpu.optimizer import init_optimizer_state
+from megatron_llm_tpu.training.checkpointing import (
+    load_checkpoint,
+    read_tracker,
+    save_checkpoint,
+)
+from megatron_llm_tpu.training.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = tiny_config()
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    tcfg = TrainConfig()
+    opt = init_optimizer_state(params, tcfg)
+    save_dir = str(tmp_path / "ckpt")
+
+    save_checkpoint(save_dir, 42, params, opt, cfg,
+                    scheduler_state={"num_steps": 42, "max_lr": 1e-4,
+                                     "min_lr": 0.0, "lr_warmup_steps": 0,
+                                     "lr_decay_steps": 100,
+                                     "lr_decay_style": "linear",
+                                     "start_wd": 0.01, "end_wd": 0.01},
+                    consumed_train_samples=336)
+    it, release = read_tracker(save_dir)
+    assert it == 42 and not release
+
+    p2, o2, meta, iteration = load_checkpoint(save_dir, params, opt, cfg)
+    assert iteration == 42
+    assert meta["consumed_train_samples"] == 336
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(o2.step) == int(opt.step)
+
+
+def test_checkpoint_finetune_resets(tmp_path):
+    cfg = tiny_config()
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    opt = init_optimizer_state(params, TrainConfig())
+    save_dir = str(tmp_path / "ckpt")
+    save_checkpoint(save_dir, 100, params, opt, cfg)
+    p2, o2, meta, iteration = load_checkpoint(save_dir, params, opt, cfg,
+                                              finetune=True)
+    assert iteration == 0  # ref: --finetune resets iteration
+    assert o2 is None  # and skips optimizer state
+
+
+def test_checkpoint_arch_mismatch(tmp_path):
+    cfg = tiny_config()
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    save_dir = str(tmp_path / "ckpt")
+    save_checkpoint(save_dir, 1, params, None, cfg)
+    bad_cfg = tiny_config(num_layers=3)
+    with pytest.raises(ValueError, match="num_layers"):
+        load_checkpoint(save_dir, params, None, bad_cfg)
+
+
+def test_constant_microbatches():
+    c = ConstantNumMicroBatches(global_batch_size=32, micro_batch_size=2,
+                                data_parallel_size=4)
+    assert c.get() == 4
+    with pytest.raises(AssertionError):
+        ConstantNumMicroBatches(30, 2, 4)
+
+
+def test_rampup_microbatches():
+    # ref microbatches.py: 16 -> 64 in +16 increments over 300 samples
+    c = RampupBatchsizeNumMicroBatches(
+        start_batch_size=16, batch_size_increment=16, ramp_samples=300,
+        global_batch_size=64, micro_batch_size=2, data_parallel_size=2,
+    )
+    assert c.get_current_global_batch_size() == 16
+    c.update(100)
+    assert c.get_current_global_batch_size() == 32
+    c.update(200)
+    assert c.get_current_global_batch_size() == 48
+    c.update(10_000)
+    assert c.get_current_global_batch_size() == 64
+    assert c.get() == 16  # 64 / (2*2)
+
+
+def test_build_calculator_dispatch():
+    c = build_num_microbatches_calculator(8, 2, 1, rampup_batch_size=(4, 2, 100))
+    assert c.get_current_global_batch_size() == 4
+
+
+def test_train_loop_smoke(tmp_path):
+    """Short end-to-end loop through Trainer (not the CLI)."""
+    from megatron_llm_tpu.training.trainer import Trainer, get_batch
+
+    cfg = tiny_config(seq_length=16, max_position_embeddings=16)
+    model = LlamaModel(cfg)
+    tcfg = TrainConfig(micro_batch_size=2, global_batch_size=4, lr=1e-3,
+                       train_iters=4, log_interval=2, eval_interval=0,
+                       clip_grad=1.0)
+    pcfg = ParallelConfig(num_microbatches=2)
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            yield rng.randint(0, 256, size=(2, 2, 17)).astype(np.int32)
+
+    trainer = Trainer(model, tcfg, pcfg, train_data_iterator=batches())
+    state = trainer.setup()
+    state = trainer.train(state)
+    assert state.iteration == 4
+    assert state.consumed_train_samples == 16
+
+
+def test_get_batch_eod_masks():
+    from megatron_llm_tpu.training.trainer import get_batch
+
+    text = np.array([[[5, 1, 9, 1, 3, 7]]], dtype=np.int32)  # eod=1
+    batch = get_batch(text, eod_token=1, reset_attention_mask=True,
+                      reset_position_ids=True, eod_mask_loss=True)
+    assert "attention_mask" in batch
+    # position ids reset after each eod
+    np.testing.assert_array_equal(
+        np.asarray(batch["position_ids"][0, 0]), [0, 1, 0, 1, 0]
+    )
+    # loss masked at eod positions
+    np.testing.assert_array_equal(np.asarray(batch["loss_mask"][0, 0]),
+                                  [1, 0, 1, 0, 1])
