@@ -142,9 +142,26 @@ _ACTIVATION_SPECS = {
 }
 
 
+_MANUAL_DEPTH = 0
+
+
+@contextlib.contextmanager
+def manual_region():
+    """Mark a shard_map(manual-axes) body: activation constraints are
+    skipped inside (this JAX rejects with_sharding_constraint mixing auto
+    axes into a manual region; GSPMD propagation from the param shardings
+    covers the body instead)."""
+    global _MANUAL_DEPTH
+    _MANUAL_DEPTH += 1
+    try:
+        yield
+    finally:
+        _MANUAL_DEPTH -= 1
+
+
 def shard_activation(x, kind: str):
     ctx = _CONTEXT
-    if ctx is None:
+    if ctx is None or _MANUAL_DEPTH:
         return x
     spec = _ACTIVATION_SPECS[kind]
     if kind == "hidden_seq" and not ctx.sequence_parallel:

@@ -1,0 +1,249 @@
+"""Pipeline parallelism — shard_map over the `stage` axis + ppermute.
+
+Parity target: ref megatron/schedules.py + p2p_communication.py. The
+reference drives 1F1B by hand: per-rank Python loops issuing batched
+NCCL isend/irecv (p2p_communication.py:204-231), explicit
+deallocate_output_tensor/custom_backward memory hacks (schedules.py:36-88),
+and a separate embedding-grad allreduce group between first and last stage
+(parallel_state.py:172-199, optimizer.py:203-229).
+
+The TPU design collapses all of that into one differentiable program:
+
+- the stacked layer axis (L, ...) is sharded over `stage`, so each stage
+  materialises only its L/pp layers;
+- a `lax.scan` over num_micro + pp - 1 ticks rotates activations with
+  `lax.ppermute` (the XLA collective-permute that rides ICI);
+- reverse-mode AD through the scan yields the backward pipeline (transpose
+  of ppermute is the reverse ppermute) — no hand-written backward schedule;
+- parameters that enter the shard_map replicated over `stage` (embedding,
+  final norm, lm head) get their gradients psum'd across stages by the
+  shard_map transpose automatically — which IS the reference's tied
+  embedding-grad sync, for free;
+- `data`/`model` axes stay in GSPMD "auto" mode inside the region, so TP/SP
+  sharding of each stage's compute keeps working unchanged.
+
+Schedule note: AD produces a GPipe-style schedule (all-forward then
+all-backward per scan transpose) rather than interleaved 1F1B; the 1F1B
+memory win is recovered with `jax.checkpoint` on the stage body (activation
+stash per microbatch = one remat'd layer chunk). A hand-scheduled
+1F1B/interleaved variant is a planned optimization (SURVEY.md §7 step 6).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_llm_tpu.models.norms import apply_norm
+from megatron_llm_tpu.models.rope import precompute_rope
+from megatron_llm_tpu.models.transformer import transformer_stack
+from megatron_llm_tpu.models.language_model import embed_tokens, lm_logits
+from megatron_llm_tpu.parallel.cross_entropy import cross_entropy
+from megatron_llm_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    STAGE_AXIS,
+    ParallelContext,
+)
+
+
+def pipeline_param_specs(cfg, params: dict) -> dict:
+    """Param specs with the layer axis sharded over `stage` (the analogue of
+    the reference assigning layer ranges to pp ranks,
+    ref: transformer.py:845-895 `_get_num_layers` + offset math)."""
+    from megatron_llm_tpu.parallel.sharding import param_specs
+
+    specs = param_specs(cfg, params)
+
+    def add_stage(spec: P) -> P:
+        parts = list(spec) or [None]
+        assert parts[0] is None, "layer axis already sharded"
+        parts[0] = STAGE_AXIS
+        return P(*parts)
+
+    specs["layers"] = jax.tree.map(
+        add_stage, specs["layers"], is_leaf=lambda x: isinstance(x, P)
+    )
+    return specs
+
+
+def _stage_body(cfg, layers_local, hidden, rope_table, mask, position_ids,
+                dropout_rng, deterministic, stage, num_stages):
+    """Run this stage's layer chunk. layer indices offset by stage
+    (ref: vpp/stage offset math transformer.py:1015-1045)."""
+    layers_per_stage = jax.tree.leaves(layers_local)[0].shape[0]
+    out, _ = transformer_stack(
+        layers_local, cfg, hidden, rope_table, mask, position_ids,
+        dropout_rng, deterministic,
+        layer_offset=stage * layers_per_stage,
+    )
+    return out
+
+
+def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
+    """loss(params, batch, rng) with the transformer stack pipelined over
+    `stage`. `batch` arrays are (num_micro, b, s[, ...]).
+
+    Replaces the reference's forward_backward_pipelining_* schedules
+    (schedules.py:253-722): here one jitted function does embed -> pipelined
+    stack -> head/CE, and jax.grad of it is the full pipelined backward.
+    """
+    cfg = model.cfg
+    mesh = ctx.mesh
+    num_stages = pcfg.pipeline_parallel_size
+
+    def loss_fn(params, batch, dropout_rng=None):
+        tokens = batch["tokens"]  # (num_micro, b, s)
+        labels = batch["labels"]
+        loss_mask = batch.get("loss_mask")
+        position_ids = batch.get("position_ids")
+        num_micro, b, s = tokens.shape
+        deterministic = dropout_rng is None
+
+        if cfg.position_embedding_type == "rotary":
+            rope_table = precompute_rope(
+                cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
+                cfg.rope_scaling_factor,
+            )
+        else:
+            rope_table = None
+
+        # ---- embed all microbatches (stage-replicated GSPMD compute) ----
+        def embed_micro(toks, pids, rng):
+            return embed_tokens(params, cfg, toks, pids, rng, deterministic)
+
+        emb_rngs = None
+        if dropout_rng is not None:
+            emb_rngs = jax.random.split(
+                jax.random.fold_in(dropout_rng, 0), num_micro
+            )
+        hidden_micro = jax.vmap(embed_micro)(
+            tokens,
+            position_ids
+            if position_ids is not None
+            else jnp.broadcast_to(jnp.arange(s)[None, None], (num_micro, 1, s)),
+            emb_rngs,
+        )  # (num_micro, b, s, h)
+
+        # ---- pipelined stack over `stage` ------------------------------
+        # Boundary/carry dtype: values whose shard_map/pcast transposes emit
+        # copy-all-reduces must not be bf16 on CPU — XLA-CPU's
+        # AllReducePromotion pass crashes cloning a copy-bodied all-reduce
+        # ("Invalid binary instruction opcode copy"). TPU keeps bf16 so the
+        # inter-stage ppermute traffic stays half-width.
+        boundary_dtype = (
+            jnp.float32 if jax.default_backend() == "cpu" else cfg.compute_dtype
+        )
+
+        def stack_shard(layers_local, hidden_mb):
+            # layers_local: (L/pp, ...); hidden_mb: (num_micro, b, s, h)
+            from megatron_llm_tpu.parallel.mesh import manual_region
+
+            with manual_region():
+                out = _stack_shard_body(
+                    layers_local, hidden_mb.astype(boundary_dtype)
+                )
+            return out.astype(jnp.float32)
+
+        def _stack_shard_body(layers_local, hidden_mb):
+            stage = jax.lax.axis_index(STAGE_AXIS)
+            total = num_micro + num_stages - 1
+            state = jnp.zeros_like(hidden_mb[0])
+
+            def tick(carry, t):
+                state, outputs = carry
+                feed = jax.lax.dynamic_index_in_dim(
+                    hidden_mb, jnp.clip(t, 0, num_micro - 1), axis=0,
+                    keepdims=False,
+                )
+                inp = jnp.where(stage == 0, feed, state).astype(cfg.compute_dtype)
+                rng_t = None
+                if dropout_rng is not None:
+                    rng_t = jax.random.fold_in(dropout_rng, 1 + t * num_stages)
+                out = _stage_body(cfg, layers_local, inp, rope_table, None,
+                                  None, rng_t, deterministic, stage, num_stages)
+                out = out.astype(boundary_dtype)
+                # last stage banks microbatch t-(pp-1) when in range
+                slot = jnp.clip(t - (num_stages - 1), 0, num_micro - 1)
+                valid = (stage == num_stages - 1) & (t >= num_stages - 1)
+                banked = jax.lax.dynamic_index_in_dim(outputs, slot, 0, False)
+                outputs = jax.lax.dynamic_update_index_in_dim(
+                    outputs, jnp.where(valid, out, banked), slot, 0
+                )
+                # rotate stage s -> s+1 (ref: send_forward
+                # p2p_communication.py:292; backward of this ppermute is the
+                # reverse rotation = send_backward :311)
+                state = jax.lax.ppermute(
+                    out, STAGE_AXIS,
+                    [(i, i + 1) for i in range(num_stages - 1)],
+                )
+                return (state, outputs), None
+
+            # carries become stage-varying inside the loop; mark the zero
+            # initials as varying so the scan carry types are stable
+            state = jax.lax.pcast(state, (STAGE_AXIS,), to="varying")
+            outputs0 = jax.lax.pcast(
+                jnp.zeros_like(hidden_mb), (STAGE_AXIS,), to="varying"
+            )
+            (_, outputs), _ = jax.lax.scan(
+                tick, (state, outputs0), jnp.arange(total)
+            )
+            # stack over a leading stage axis: each stage contributes its
+            # banked buffer (only the last stage's is meaningful); the
+            # caller slices [-1], which XLA lowers to one transfer from the
+            # last stage (the analogue of the last->first stage broadcast,
+            # ref: text_generation/communication.py:111).
+            return outputs[None]
+
+        stack_mapped = jax.shard_map(
+            stack_shard,
+            mesh=mesh,
+            in_specs=(P(STAGE_AXIS), P()),
+            out_specs=P(STAGE_AXIS),
+            axis_names={STAGE_AXIS},
+        )
+        hidden_out = stack_mapped(
+            params["layers"], hidden_micro.astype(jnp.float32)
+        )[-1].astype(cfg.compute_dtype)
+
+        # ---- head + loss (stage-replicated) -----------------------------
+        def head_micro(hidden, lbls, lmask):
+            h = apply_norm(hidden, params["final_norm"], cfg)
+            logits = lm_logits(params, cfg, h)
+            losses = cross_entropy(logits, lbls)
+            if lmask is None:
+                return jnp.sum(losses), jnp.float32(losses.size)
+            lmask = lmask.astype(jnp.float32)
+            return jnp.sum(losses * lmask), jnp.sum(lmask)
+
+        if loss_mask is None:
+            sums, denoms = jax.vmap(lambda h, l: head_micro(h, l, None))(
+                hidden_out, labels
+            )
+        else:
+            sums, denoms = jax.vmap(head_micro)(hidden_out, labels, loss_mask)
+        return jnp.sum(sums) / jnp.maximum(jnp.sum(denoms), 1.0)
+
+    return loss_fn
+
+
+def make_pipelined_train_step(model, tcfg, pcfg, ctx: ParallelContext):
+    """train_step(params, opt_state, batch, lr, wd, rng) for pp > 1
+    (ref: train_step + get_forward_backward_func, training.py:391-431)."""
+    from megatron_llm_tpu.optimizer.optimizer import optimizer_step
+
+    loss_fn = make_pipelined_loss_fn(model, pcfg, ctx)
+
+    def train_step(params, opt_state, batch, lr, wd, rng=None):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        params, opt_state, stats = optimizer_step(
+            params, grads, opt_state, tcfg, lr, weight_decay=wd
+        )
+        stats["loss"] = loss
+        return params, opt_state, stats
+
+    return train_step

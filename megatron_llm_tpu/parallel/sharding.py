@@ -91,9 +91,12 @@ def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
     return spec
 
 
-def optimizer_state_specs(cfg, params: dict, dp: int, distributed: bool) -> Any:
-    """Specs for one params-shaped moment tree (m or v)."""
-    specs = param_specs(cfg, params)
+def optimizer_state_specs(cfg, params: dict, dp: int, distributed: bool,
+                          base_specs: Any = None) -> Any:
+    """Specs for one params-shaped moment tree (m or v). `base_specs`
+    overrides the default param specs (e.g. the pipeline variant with the
+    layer axis on `stage`)."""
+    specs = base_specs if base_specs is not None else param_specs(cfg, params)
     if not distributed or dp <= 1:
         return specs
     flat_params = jax.tree.leaves(params)

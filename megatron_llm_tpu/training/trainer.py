@@ -176,13 +176,19 @@ class Trainer:
 
             mesh = self.ctx.mesh
             tmpl = jax.eval_shape(self.model.init, rng)
-            pspecs = param_specs(self.cfg, tmpl)
+            if self.pcfg.pipeline_parallel_size > 1:
+                from megatron_llm_tpu.parallel.pipeline import (
+                    pipeline_param_specs as param_specs_fn,
+                )
+            else:
+                param_specs_fn = param_specs
+            pspecs = param_specs_fn(self.cfg, tmpl)
             psh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
                                is_leaf=lambda x: isinstance(x, P))
             params = jax.jit(self.model.init, out_shardings=psh)(rng)
             ospecs = optimizer_state_specs(
                 self.cfg, tmpl, self.pcfg.data_parallel_size,
-                self.pcfg.use_distributed_optimizer,
+                self.pcfg.use_distributed_optimizer, base_specs=pspecs,
             )
             osh = jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs,
                                is_leaf=lambda x: isinstance(x, P))
@@ -224,9 +230,19 @@ class Trainer:
             import dataclasses as _dc
 
             pcfg = _dc.replace(self.pcfg, num_microbatches=num_microbatches)
+            if pcfg.pipeline_parallel_size > 1:
+                assert self.ctx is not None, "pp>1 requires an installed mesh"
+                from megatron_llm_tpu.parallel.pipeline import (
+                    make_pipelined_train_step,
+                )
+
+                fn = make_pipelined_train_step(
+                    self.model, self.tcfg, pcfg, self.ctx
+                )
+            else:
+                fn = make_train_step(self.model, self.tcfg, pcfg)
             self._train_steps[num_microbatches] = jax.jit(
-                make_train_step(self.model, self.tcfg, pcfg),
-                donate_argnums=(0, 1),
+                fn, donate_argnums=(0, 1)
             )
         return self._train_steps[num_microbatches]
 

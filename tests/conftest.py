@@ -19,7 +19,14 @@ if "host_platform_device_count" not in prev:
 
 import jax  # noqa: E402
 
+# The axon sitecustomize (see /root/.axon_site) sets jax_platforms=axon,cpu
+# at interpreter start; override before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)
 
 import pytest  # noqa: E402
 

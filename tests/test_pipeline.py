@@ -1,0 +1,119 @@
+"""Pipeline parallelism tests (ref analogue: the schedule invariants of
+schedules.py — same math as no-pipelining, tested at pp>1 on the virtual
+CPU mesh, which the reference cannot do without GPUs; SURVEY.md §4)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig, tiny_config
+from megatron_llm_tpu.models import LlamaModel
+from megatron_llm_tpu.parallel import initialize_parallel
+from megatron_llm_tpu.parallel.mesh import destroy_parallel
+from megatron_llm_tpu.parallel.pipeline import (
+    make_pipelined_loss_fn,
+    make_pipelined_train_step,
+    pipeline_param_specs,
+)
+
+
+@pytest.fixture
+def pp4():
+    ctx = initialize_parallel(dp=2, pp=4, tp=1)
+    yield ctx
+    destroy_parallel()
+
+
+def _setup(ctx, pp, num_micro=4, mbs=2, seq=16):
+    cfg = tiny_config(num_layers=4, seq_length=seq, max_position_embeddings=seq)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    pspecs = pipeline_param_specs(cfg, params)
+    psh = jax.tree.map(lambda s: NamedSharding(ctx.mesh, s), pspecs,
+                       is_leaf=lambda x: isinstance(x, P))
+    params = jax.device_put(params, psh)
+    tokens = jax.random.randint(jax.random.key(1), (num_micro, mbs, seq), 0, 256)
+    batch = {"tokens": tokens, "labels": jnp.roll(tokens, -1, axis=-1)}
+    return cfg, model, params, batch
+
+
+def test_pipelined_loss_matches_single_device(pp4):
+    ctx = pp4
+    pcfg = ParallelConfig(data_parallel_size=2, pipeline_parallel_size=4,
+                          num_microbatches=4)
+    cfg, model, params, batch = _setup(ctx, 4)
+
+    loss_fn = jax.jit(make_pipelined_loss_fn(model, pcfg, ctx))
+    pipelined = float(loss_fn(params, batch))
+
+    # single-device reference: mean CE over all microbatches
+    params_host = jax.device_get(params)
+    ref_losses = []
+    for m in range(4):
+        ref_losses.append(float(model.loss(
+            params_host, batch["tokens"][m], batch["labels"][m]
+        )))
+    ref = float(np.mean(ref_losses))
+    np.testing.assert_allclose(pipelined, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_pipelined_grads_match_single_device(pp4):
+    ctx = pp4
+    pcfg = ParallelConfig(data_parallel_size=2, pipeline_parallel_size=4,
+                          num_microbatches=4)
+    cfg, model, params, batch = _setup(ctx, 4)
+
+    loss_fn = make_pipelined_loss_fn(model, pcfg, ctx)
+    grads = jax.jit(jax.grad(loss_fn))(params, batch)
+
+    def ref_loss(p):
+        losses = [model.loss(p, batch["tokens"][m], batch["labels"][m])
+                  for m in range(4)]
+        return sum(losses) / 4.0
+
+    ref_grads = jax.grad(ref_loss)(jax.device_get(params))
+    flat, _ = jax.tree.flatten(grads)
+    ref_flat, _ = jax.tree.flatten(ref_grads)
+    for g, rg in zip(flat, ref_flat):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(rg, np.float32),
+            rtol=5e-3, atol=5e-4,
+        )
+
+
+def test_pipelined_train_step_runs(pp4):
+    ctx = pp4
+    pcfg = ParallelConfig(data_parallel_size=2, pipeline_parallel_size=4,
+                          num_microbatches=4, sequence_parallel=False)
+    cfg, model, params, batch = _setup(ctx, 4)
+    tcfg = TrainConfig(micro_batch_size=2, global_batch_size=16)
+
+    from megatron_llm_tpu.optimizer import init_optimizer_state
+
+    opt_state = init_optimizer_state(jax.device_get(params), tcfg)
+    step = jax.jit(make_pipelined_train_step(model, tcfg, pcfg, ctx),
+                   donate_argnums=(0, 1))
+    l0 = None
+    for i in range(3):
+        params, opt_state, stats = step(
+            params, opt_state, batch, jnp.float32(1e-2), jnp.float32(0.0)
+        )
+        if l0 is None:
+            l0 = float(stats["loss"])
+    assert float(stats["loss"]) < l0
+    assert np.isfinite(float(stats["grad_norm"]))
+
+
+def test_pipeline_param_specs_stage_axis():
+    cfg = tiny_config(num_layers=4)
+    model = LlamaModel(cfg)
+    params = jax.eval_shape(model.init, jax.random.key(0))
+    specs = pipeline_param_specs(cfg, params)
+    for leaf in jax.tree.leaves(specs["layers"],
+                                is_leaf=lambda x: isinstance(x, P)):
+        assert leaf[0] == "stage"
+    assert specs["embedding"]["word_embeddings"][0] == "model"
