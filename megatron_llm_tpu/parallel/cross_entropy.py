@@ -52,7 +52,9 @@ def _ce_shard(logits, targets, vocab_per_shard, label_smoothing):
     rank = jax.lax.axis_index(MODEL_AXIS)
     logits = logits.astype(jnp.float32)
     local_max = jnp.max(logits, axis=-1)
-    global_max = jax.lax.pmax(local_max, MODEL_AXIS)
+    # max is a numerical-stability shift only — no gradient flows through it
+    # (the GSPMD path stop_gradients it too; pmax has no VJP rule)
+    global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), MODEL_AXIS)
     shifted = logits - global_max[..., None]
     exp = jnp.exp(shifted)
     sum_exp = jax.lax.psum(jnp.sum(exp, axis=-1), MODEL_AXIS)

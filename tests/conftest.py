@@ -5,17 +5,19 @@ The reference cannot test collectives without >=2 real GPUs
 run anywhere. Must set env vars before jax initializes.
 """
 
+import importlib.util
 import os
 
-# Disable the axon TPU plugin (its sitecustomize registers the TPU whenever
-# PALLAS_AXON_POOL_IPS is set, overriding JAX_PLATFORMS).
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
-os.environ["JAX_PLATFORMS"] = "cpu"
-prev = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in prev:
-    os.environ["XLA_FLAGS"] = (
-        prev + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Load the shared provisioning helper WITHOUT importing the package (the
+# package __init__ imports jax; env must be set before jax loads).
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "_virtual_mesh",
+    os.path.join(_repo, "megatron_llm_tpu", "utils", "virtual_mesh.py"),
+)
+_vm = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_vm)
+_vm.force_virtual_cpu_devices(8)
 
 import jax  # noqa: E402
 
