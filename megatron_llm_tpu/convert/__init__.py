@@ -1,0 +1,6 @@
+from megatron_llm_tpu.convert.hf import (  # noqa: F401
+    hf_falcon_to_native,
+    hf_llama_to_native,
+    native_to_hf_falcon,
+    native_to_hf_llama,
+)

@@ -1,0 +1,297 @@
+"""HuggingFace <-> native weight converters (Llama/CodeLlama, Falcon).
+
+Parity targets: ref weights2megatron/weights2megatron.py:80-146
+(`llama_to_megatron` grouped-qkv rearrange + per-head RoPE permute via
+permute_qkv.py:12-30) and megatron2hf.py:60-93 (`convert_wqkv`/`convert_ffn`
+reverse direction). Everything here is plain numpy on host — no torch, no
+jax — so the CLI can stream layer by layer without framework overhead.
+
+Layout facts (see models/attention.py, models/transformer.py):
+
+- native fused wqkv is (h, qkv_size) [input-major]; the output dim is the
+  reference's grouped layout [group g: q_g0..q_g{qpk-1}, k_g, v_g] — the
+  transpose of the reference's (qkv_size, h) torch Linear weight.
+- native RoPE is the Meta interleaved-pair convention (models/rope.py); HF
+  Llama/Falcon checkpoints use the half-split ("rotate_half") convention,
+  so each q/k head's rows are permuted exactly as the reference does
+  (permute_qkv.py:15-18): HF [r0..r_{d/2-1}, i0..i_{d/2-1}] <->
+  interleaved [r0, i0, r1, i1, ...]. v is never permuted.
+- native GLU w1 is (h, 2, ffn) with index 0 = gate, 1 = up (the reference
+  packs [up; gate] into one 2*ffn dim, transformer.py:92-102 — we keep the
+  pair axis explicit so TP sharding never crosses it).
+- vocab padding: native tables may be padded beyond the HF vocab
+  (cfg.pad_vocab_size); extra rows/cols are zero-filled on import and
+  sliced off on export.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import numpy as np
+
+Array = np.ndarray
+StateDict = Dict[str, Array]
+
+
+# ---------------------------------------------------------------------------
+# Per-head RoPE-convention permutation
+# ---------------------------------------------------------------------------
+
+
+def permute_rope_rows(w: Array, head_dim: int, revert: bool = False) -> Array:
+    """Permute the leading (n_heads*head_dim) rows of `w` between the HF
+    half-split layout and the interleaved-pair layout, per head.
+
+    revert=False: HF -> interleaved (ref permute_qkv.py:18).
+    revert=True:  interleaved -> HF (ref permute_qkv.py:17).
+    """
+    n = w.shape[0] // head_dim
+    heads = w.reshape(n, head_dim, *w.shape[1:])
+    if revert:
+        # [r0,i0,r1,i1,...] -> [r..., i...]
+        out = heads.reshape(n, head_dim // 2, 2, *w.shape[1:]).swapaxes(1, 2)
+    else:
+        # [r..., i...] -> [r0,i0,...]
+        out = heads.reshape(n, 2, head_dim // 2, *w.shape[1:]).swapaxes(1, 2)
+    return out.reshape(w.shape)
+
+
+def build_grouped_qkv(
+    wq: Array, wk: Array, wv: Array, head_dim: int, n_heads: int, n_kv: int,
+    permute: bool = True,
+) -> Array:
+    """Interleave per-group [q*qpk, k, v] along dim 0 (out-major), applying
+    the RoPE permute to q/k heads (ref: rearrange_qkv
+    weights2megatron.py:87-99). Inputs are torch-Linear-layout (out, in)."""
+    qpk = n_heads // n_kv
+    if permute:
+        wq = permute_rope_rows(wq, head_dim)
+        wk = permute_rope_rows(wk, head_dim)
+    q = wq.reshape(n_kv, qpk, head_dim, -1)
+    k = wk.reshape(n_kv, 1, head_dim, -1)
+    v = wv.reshape(n_kv, 1, head_dim, -1)
+    grouped = np.concatenate([q, k, v], axis=1)  # (n_kv, qpk+2, d, in)
+    return grouped.reshape(n_kv * (qpk + 2) * head_dim, -1)
+
+
+def split_grouped_qkv(
+    qkv: Array, head_dim: int, n_heads: int, n_kv: int, permute: bool = True,
+):
+    """Inverse of build_grouped_qkv (ref: convert_wqkv megatron2hf.py:60-86)."""
+    qpk = n_heads // n_kv
+    grouped = qkv.reshape(n_kv, qpk + 2, head_dim, -1)
+    wq = grouped[:, :qpk].reshape(n_heads * head_dim, -1)
+    wk = grouped[:, qpk].reshape(n_kv * head_dim, -1)
+    wv = grouped[:, qpk + 1].reshape(n_kv * head_dim, -1)
+    if permute:
+        wq = permute_rope_rows(wq, head_dim, revert=True)
+        wk = permute_rope_rows(wk, head_dim, revert=True)
+    return wq, wk, wv
+
+
+def _pad_rows(w: Array, rows: int) -> Array:
+    if w.shape[0] == rows:
+        return w
+    assert w.shape[0] < rows, (w.shape, rows)
+    pad = np.zeros((rows - w.shape[0],) + w.shape[1:], w.dtype)
+    return np.concatenate([w, pad], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+
+def hf_llama_to_native(sd: Mapping[str, Array], cfg, dtype=np.float32) -> dict:
+    """transformers LlamaForCausalLM state dict -> native params pytree.
+
+    `sd` maps HF names to numpy arrays in torch Linear layout (out, in) —
+    a plain dict or a lazy mapping (e.g. safetensors-backed) that loads
+    each tensor on first access, so conversion streams layer by layer.
+    ref: llama_to_megatron (weights2megatron.py:80-146), source="hf".
+    """
+    L, d = cfg.num_layers, cfg.head_dim
+    n, n_kv = cfg.num_attention_heads, cfg.num_query_groups
+    dt = dtype  # fp32 masters by default (optimizer.py design)
+
+    def get(name):
+        return np.asarray(sd[name], np.float32)
+
+    cast = lambda x: np.asarray(x, dt)  # cast per layer to keep peak RAM low
+    wqkv, wo, w1, w2, in_n, post_n = [], [], [], [], [], []
+    for i in range(L):
+        p = f"model.layers.{i}"
+        qkv = build_grouped_qkv(
+            get(f"{p}.self_attn.q_proj.weight"),
+            get(f"{p}.self_attn.k_proj.weight"),
+            get(f"{p}.self_attn.v_proj.weight"),
+            d, n, n_kv,
+        )
+        wqkv.append(cast(qkv.T))  # (h, qkv_size)
+        wo.append(cast(get(f"{p}.self_attn.o_proj.weight").T))  # (heads*d, h)
+        gate = get(f"{p}.mlp.gate_proj.weight").T  # (h, ffn)
+        up = get(f"{p}.mlp.up_proj.weight").T
+        w1.append(cast(np.stack([gate, up], axis=1)))  # (h, 2, ffn)
+        w2.append(cast(get(f"{p}.mlp.down_proj.weight").T))  # (ffn, h)
+        in_n.append(cast(get(f"{p}.input_layernorm.weight")))
+        post_n.append(cast(get(f"{p}.post_attention_layernorm.weight")))
+
+    emb = _pad_rows(get("model.embed_tokens.weight"), cfg.padded_vocab_size)
+    head = _pad_rows(get("lm_head.weight"), cfg.padded_vocab_size).T  # (h, V)
+
+    return {
+        "embedding": {"word_embeddings": cast(emb)},
+        "layers": {
+            "input_norm": {"scale": np.stack(in_n)},
+            "attention": {"wqkv": np.stack(wqkv), "wo": np.stack(wo)},
+            "mlp": {"w1": np.stack(w1), "w2": np.stack(w2)},
+            "post_attention_norm": {"scale": np.stack(post_n)},
+        },
+        "final_norm": {"scale": cast(get("model.norm.weight"))},
+        "lm_head": cast(head),
+    }
+
+
+def native_to_hf_llama(params: Mapping, cfg, vocab_size: int = None) -> StateDict:
+    """native params -> transformers LlamaForCausalLM state dict
+    (ref: write_llama_model megatron2hf.py:93-200)."""
+    L, d = cfg.num_layers, cfg.head_dim
+    n, n_kv = cfg.num_attention_heads, cfg.num_query_groups
+    V = vocab_size or cfg.padded_vocab_size
+    npf = lambda x: np.asarray(x, np.float32)
+
+    layers = params["layers"]
+    sd: StateDict = {
+        "model.embed_tokens.weight": npf(
+            params["embedding"]["word_embeddings"]
+        )[:V],
+        "model.norm.weight": npf(params["final_norm"]["scale"]),
+        "lm_head.weight": npf(params["lm_head"]).T[:V],
+    }
+    for i in range(L):
+        p = f"model.layers.{i}"
+        wq, wk, wv = split_grouped_qkv(
+            npf(layers["attention"]["wqkv"][i]).T, d, n, n_kv
+        )
+        sd[f"{p}.self_attn.q_proj.weight"] = wq
+        sd[f"{p}.self_attn.k_proj.weight"] = wk
+        sd[f"{p}.self_attn.v_proj.weight"] = wv
+        sd[f"{p}.self_attn.o_proj.weight"] = npf(layers["attention"]["wo"][i]).T
+        w1 = npf(layers["mlp"]["w1"][i])  # (h, 2, ffn)
+        sd[f"{p}.mlp.gate_proj.weight"] = w1[:, 0].T
+        sd[f"{p}.mlp.up_proj.weight"] = w1[:, 1].T
+        sd[f"{p}.mlp.down_proj.weight"] = npf(layers["mlp"]["w2"][i]).T
+        sd[f"{p}.input_layernorm.weight"] = npf(layers["input_norm"]["scale"][i])
+        sd[f"{p}.post_attention_layernorm.weight"] = npf(
+            layers["post_attention_norm"]["scale"][i]
+        )
+    return sd
+
+
+# ---------------------------------------------------------------------------
+# Falcon
+# ---------------------------------------------------------------------------
+
+
+def hf_falcon_to_native(sd: Mapping[str, Array], cfg, dtype=np.float32) -> dict:
+    """transformers FalconForCausalLM state dict -> native params.
+
+    HF Falcon already stores qkv fused in the grouped layout
+    ([g: q*qpk, k, v] for new_decoder_architecture; [q..., k, v] == one
+    group under multi_query) — only the per-head RoPE permute is needed
+    (ref: falcon_to_megatron weights2megatron.py:23-79).
+    """
+    L, d = cfg.num_layers, cfg.head_dim
+
+    def get(name):
+        return np.asarray(sd[name], np.float32)
+
+    cast = lambda x: np.asarray(x, dtype)
+    wqkv, wo, w1, w2 = [], [], [], []
+    in_w, in_b, mlp_w, mlp_b = [], [], [], []
+    for i in range(L):
+        p = f"transformer.h.{i}"
+        qkv = get(f"{p}.self_attention.query_key_value.weight")
+        qkv = _permute_falcon_qkv(qkv, cfg)
+        wqkv.append(cast(qkv.T))
+        wo.append(cast(get(f"{p}.self_attention.dense.weight").T))
+        w1.append(cast(get(f"{p}.mlp.dense_h_to_4h.weight").T))
+        w2.append(cast(get(f"{p}.mlp.dense_4h_to_h.weight").T))
+        if cfg.parallel_layernorm:  # falcon-40b: ln_attn + ln_mlp
+            in_w.append(cast(get(f"{p}.ln_attn.weight")))
+            in_b.append(cast(get(f"{p}.ln_attn.bias")))
+            mlp_w.append(cast(get(f"{p}.ln_mlp.weight")))
+            mlp_b.append(cast(get(f"{p}.ln_mlp.bias")))
+        else:
+            in_w.append(cast(get(f"{p}.input_layernorm.weight")))
+            in_b.append(cast(get(f"{p}.input_layernorm.bias")))
+
+    emb = cast(_pad_rows(
+        get("transformer.word_embeddings.weight"), cfg.padded_vocab_size
+    ))
+    layers = {
+        "input_norm": {"scale": np.stack(in_w), "bias": np.stack(in_b)},
+        "attention": {"wqkv": np.stack(wqkv), "wo": np.stack(wo)},
+        "mlp": {"w1": np.stack(w1), "w2": np.stack(w2)},
+    }
+    if cfg.parallel_layernorm:
+        layers["mlp_norm"] = {"scale": np.stack(mlp_w), "bias": np.stack(mlp_b)}
+    return {
+        "embedding": {"word_embeddings": emb},
+        "layers": layers,
+        "final_norm": {
+            "scale": cast(get("transformer.ln_f.weight")),
+            "bias": cast(get("transformer.ln_f.bias")),
+        },
+    }
+
+
+def native_to_hf_falcon(params: Mapping, cfg, vocab_size: int = None) -> StateDict:
+    """native params -> transformers FalconForCausalLM state dict."""
+    L = cfg.num_layers
+    V = vocab_size or cfg.padded_vocab_size
+    npf = lambda x: np.asarray(x, np.float32)
+    layers = params["layers"]
+    emb = npf(params["embedding"]["word_embeddings"])[:V]
+    sd: StateDict = {
+        "transformer.word_embeddings.weight": emb,
+        "lm_head.weight": emb,  # tied (ref asserts allclose, w2m.py:41-42)
+        "transformer.ln_f.weight": npf(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": npf(params["final_norm"]["bias"]),
+    }
+    for i in range(L):
+        p = f"transformer.h.{i}"
+        qkv = npf(layers["attention"]["wqkv"][i]).T
+        sd[f"{p}.self_attention.query_key_value.weight"] = _permute_falcon_qkv(
+            qkv, cfg, revert=True
+        )
+        sd[f"{p}.self_attention.dense.weight"] = npf(
+            layers["attention"]["wo"][i]
+        ).T
+        sd[f"{p}.mlp.dense_h_to_4h.weight"] = npf(layers["mlp"]["w1"][i]).T
+        sd[f"{p}.mlp.dense_4h_to_h.weight"] = npf(layers["mlp"]["w2"][i]).T
+        if cfg.parallel_layernorm:
+            sd[f"{p}.ln_attn.weight"] = npf(layers["input_norm"]["scale"][i])
+            sd[f"{p}.ln_attn.bias"] = npf(layers["input_norm"]["bias"][i])
+            sd[f"{p}.ln_mlp.weight"] = npf(layers["mlp_norm"]["scale"][i])
+            sd[f"{p}.ln_mlp.bias"] = npf(layers["mlp_norm"]["bias"][i])
+        else:
+            sd[f"{p}.input_layernorm.weight"] = npf(
+                layers["input_norm"]["scale"][i]
+            )
+            sd[f"{p}.input_layernorm.bias"] = npf(
+                layers["input_norm"]["bias"][i]
+            )
+    return sd
+
+
+def _permute_falcon_qkv(qkv: Array, cfg, revert: bool = False) -> Array:
+    """RoPE-permute each q and k head inside a fused grouped qkv weight,
+    leaving v untouched (ref: permute_qkv.py:22-29 group loop)."""
+    d, qpk, n_kv = cfg.head_dim, cfg.q_per_kv, cfg.num_query_groups
+    grouped = qkv.reshape(n_kv, qpk + 2, d, -1)
+    qk = grouped[:, : qpk + 1].reshape(n_kv * (qpk + 1) * d, -1)
+    qk = permute_rope_rows(qk, d, revert=revert).reshape(n_kv, qpk + 1, d, -1)
+    out = np.concatenate([qk, grouped[:, qpk + 1 :]], axis=1)
+    return out.reshape(qkv.shape)

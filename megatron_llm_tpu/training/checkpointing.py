@@ -46,9 +46,9 @@ def read_tracker(load_dir: str) -> Tuple[Optional[int], bool]:
     return int(raw), False
 
 
-def _write_tracker(save_dir: str, iteration: int) -> None:
+def _write_tracker(save_dir: str, iteration: int, release: bool = False) -> None:
     with open(os.path.join(save_dir, TRACKER_FILENAME), "w") as f:
-        f.write(str(iteration))
+        f.write("release" if release else str(iteration))
 
 
 def _config_meta(model_cfg) -> dict:
@@ -85,9 +85,11 @@ def save_checkpoint(
     consumed_train_samples: int = 0,
     rng_key: Optional[jax.Array] = None,
     extra_meta: Optional[dict] = None,
+    release: bool = False,
 ) -> str:
-    """ref: save_checkpoint (checkpointing.py:243-338)."""
-    path = checkpoint_dir(save_dir, iteration)
+    """ref: save_checkpoint (checkpointing.py:243-338). `release=True`
+    writes the converter layout (ref: "release" naming, checkpointing.py:93)."""
+    path = checkpoint_dir(save_dir, iteration, release=release)
     os.makedirs(save_dir, exist_ok=True)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(path, "model"), params, force=True)
@@ -111,7 +113,7 @@ def save_checkpoint(
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     ckptr.wait_until_finished()
-    _write_tracker(save_dir, iteration)
+    _write_tracker(save_dir, iteration, release=release)
     return path
 
 
@@ -132,6 +134,7 @@ def load_checkpoint(
     same checkpoint loads under any mesh. Returns
     (params, opt_state|None, meta, iteration).
     """
+    release = False
     if iteration is None:
         iteration, release = read_tracker(load_dir)
         if iteration is None and not release:
@@ -149,8 +152,12 @@ def load_checkpoint(
     abstract_params = jax.tree.map(ocp.utils.to_shape_dtype_struct, params_template)
     params = ckptr.restore(os.path.join(path, "model"), abstract_params)
 
+    # release checkpoints (converter output) carry weights only: load like
+    # --finetune — no optimizer/rng, iteration 0 (ref: checkpointing.py:583-625,
+    # release naming :93)
     opt_state = None
-    if opt_state_template is not None and not finetune and not no_load_optim:
+    if (opt_state_template is not None and not finetune and not no_load_optim
+            and not release):
         from megatron_llm_tpu.optimizer.optimizer import OptimizerState
 
         tmpl = {"step": opt_state_template.step, "m": opt_state_template.m}
@@ -163,8 +170,8 @@ def load_checkpoint(
         )
 
     # --finetune resets iteration and skips optim/rng (ref :583-625)
-    out_iteration = 0 if finetune else meta["iteration"]
-    if finetune or no_load_rng:
+    out_iteration = 0 if (finetune or release) else meta["iteration"]
+    if finetune or no_load_rng or release:
         meta = dict(meta)
         meta["rng_key"] = None
     return params, opt_state, meta, out_iteration

@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Convert weights between HuggingFace and native checkpoints.
+
+The TPU rebuild of ref weights2megatron/weights2megatron.py:148-271 (main)
+and megatron2hf.py (reverse). Examples:
+
+    # HF Llama dir -> native orbax "release" checkpoint
+    python tools/convert_weights.py --model llama --direction hf2native \
+        --input /path/to/hf-llama --output /path/to/native-ckpt
+
+    # trained native checkpoint -> HF dir loadable by from_pretrained
+    python tools/convert_weights.py --model llama --direction native2hf \
+        --input /path/to/native-ckpt --output /path/to/hf-out
+
+The native side needs no tp/pp resharding step: orbax/tensorstore restores
+under any mesh (the reason tools/checkpoint_util.py from the reference has
+no analogue here; see training/checkpointing.py docstring).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _model_cfg_from_hf(model: str, hf_cfg, dtype):
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.config import falcon_config, llama_config
+
+    dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
+    if model == "llama":
+        return llama_config(
+            7,  # size key irrelevant: every field overridden below
+            num_layers=hf_cfg.num_hidden_layers,
+            hidden_size=hf_cfg.hidden_size,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_attention_heads_kv=getattr(
+                hf_cfg, "num_key_value_heads", hf_cfg.num_attention_heads
+            ),
+            ffn_hidden_size=hf_cfg.intermediate_size,
+            seq_length=hf_cfg.max_position_embeddings,
+            max_position_embeddings=hf_cfg.max_position_embeddings,
+            vocab_size=hf_cfg.vocab_size,
+            padded_vocab_size=hf_cfg.vocab_size,
+            rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+            layernorm_epsilon=hf_cfg.rms_norm_eps,
+            params_dtype=dt,
+        )
+    if model == "falcon":
+        n_kv = (
+            hf_cfg.num_kv_heads
+            if getattr(hf_cfg, "new_decoder_architecture", False)
+            else (1 if getattr(hf_cfg, "multi_query", True)
+                  else hf_cfg.num_attention_heads)
+        )
+        return falcon_config(
+            7,
+            num_layers=hf_cfg.num_hidden_layers,
+            hidden_size=hf_cfg.hidden_size,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_attention_heads_kv=n_kv,
+            ffn_hidden_size=4 * hf_cfg.hidden_size,
+            seq_length=2048,
+            vocab_size=hf_cfg.vocab_size,
+            padded_vocab_size=hf_cfg.vocab_size,
+            parallel_layernorm=getattr(
+                hf_cfg, "new_decoder_architecture", False
+            ),
+            params_dtype=dt,
+        )
+    raise ValueError(model)
+
+
+class LazySafetensorsDict:
+    """Read-on-demand mapping over a HF safetensors checkpoint (single file
+    or sharded with model.safetensors.index.json). Conversion touches each
+    tensor exactly once, so peak host RAM stays ~one tensor instead of a
+    whole fp32 model copy."""
+
+    def __init__(self, hf_dir: str):
+        from safetensors import safe_open
+
+        self._open = safe_open
+        index = os.path.join(hf_dir, "model.safetensors.index.json")
+        if os.path.isfile(index):
+            with open(index) as f:
+                self._map = {
+                    k: os.path.join(hf_dir, v)
+                    for k, v in json.load(f)["weight_map"].items()
+                }
+        else:
+            single = os.path.join(hf_dir, "model.safetensors")
+            if not os.path.isfile(single):
+                raise FileNotFoundError(
+                    f"no safetensors checkpoint under {hf_dir}"
+                )
+            with safe_open(single, framework="np") as f:
+                self._map = {k: single for k in f.keys()}
+        self._handles = {}
+
+    def keys(self):
+        return self._map.keys()
+
+    def __contains__(self, name):
+        return name in self._map
+
+    def __getitem__(self, name):
+        path = self._map[name]
+        if path not in self._handles:
+            self._handles[path] = self._open(path, framework="np")
+        t = self._handles[path].get_tensor(name)
+        # bf16 shards arrive as ml_dtypes.bfloat16; converters upcast anyway
+        return np.asarray(t, np.float32)
+
+
+def hf2native(args) -> None:
+    from transformers import AutoConfig
+
+    from megatron_llm_tpu.convert import hf_falcon_to_native, hf_llama_to_native
+    from megatron_llm_tpu.training.checkpointing import save_checkpoint
+
+    hf_cfg = AutoConfig.from_pretrained(args.input)
+    cfg = _model_cfg_from_hf(args.model, hf_cfg, args.dtype)
+    print(f"reading HF {args.model} safetensors from {args.input} ...",
+          flush=True)
+    try:
+        sd = LazySafetensorsDict(args.input)
+    except FileNotFoundError:
+        # .bin-only checkpoints: fall back to a full torch load
+        import torch
+        from transformers import AutoModelForCausalLM
+
+        hf = AutoModelForCausalLM.from_pretrained(
+            args.input, torch_dtype=torch.float32
+        )
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        del hf
+
+    import ml_dtypes
+
+    dt = {"float32": np.float32, "bfloat16": ml_dtypes.bfloat16}[args.dtype]
+    convert = hf_llama_to_native if args.model == "llama" else hf_falcon_to_native
+    params = convert(sd, cfg, dtype=dt)
+    path = save_checkpoint(
+        args.output, 0, params, model_cfg=cfg, release=True,
+        extra_meta={"source": f"hf:{args.input}"},
+    )
+    print(f"wrote native release checkpoint to {path}", flush=True)
+
+
+def native2hf(args) -> None:
+    import jax
+
+    from megatron_llm_tpu.convert import native_to_hf_falcon, native_to_hf_llama
+    from megatron_llm_tpu.training.checkpointing import (
+        checkpoint_dir,
+        read_tracker,
+    )
+
+    import orbax.checkpoint as ocp
+
+    iteration, release = read_tracker(args.input)
+    path = checkpoint_dir(args.input, iteration or 0, release=release)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    saved = meta["config"]
+
+    from megatron_llm_tpu.config import falcon_config, llama_config
+
+    common = dict(
+        num_layers=saved["num_layers"],
+        hidden_size=saved["hidden_size"],
+        num_attention_heads=saved["num_attention_heads"],
+        num_attention_heads_kv=saved["num_attention_heads_kv"],
+        ffn_hidden_size=saved["ffn_hidden_size"],
+        seq_length=saved["seq_length"],
+        max_position_embeddings=saved["max_position_embeddings"],
+        padded_vocab_size=saved["padded_vocab_size"],
+        rope_theta=saved["rope_theta"],
+        layernorm_epsilon=saved["layernorm_epsilon"],
+    )
+    if args.model == "llama":
+        cfg = llama_config(7, vocab_size=saved["padded_vocab_size"], **common)
+    else:
+        cfg = falcon_config(
+            7, vocab_size=saved["padded_vocab_size"],
+            parallel_layernorm=saved["parallel_layernorm"], **common,
+        )
+
+    from megatron_llm_tpu.models import FalconModel, LlamaModel
+
+    model = (LlamaModel if args.model == "llama" else FalconModel)(cfg)
+    tmpl = jax.eval_shape(model.init, jax.random.key(0))
+    params = ocp.StandardCheckpointer().restore(
+        os.path.join(path, "model"),
+        jax.tree.map(ocp.utils.to_shape_dtype_struct, tmpl),
+    )
+
+    vocab = args.true_vocab_size or saved["padded_vocab_size"]
+    convert = native_to_hf_llama if args.model == "llama" else native_to_hf_falcon
+    sd = convert(params, cfg, vocab_size=vocab)
+
+    import torch
+    from transformers import FalconConfig, FalconForCausalLM, LlamaConfig, LlamaForCausalLM
+
+    if args.model == "llama":
+        hf_cfg = LlamaConfig(
+            vocab_size=vocab, hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.ffn_hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_attention_heads_kv,
+            max_position_embeddings=cfg.max_position_embeddings,
+            rms_norm_eps=cfg.layernorm_epsilon, rope_theta=cfg.rope_theta,
+            tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+        )
+        hf = LlamaForCausalLM(hf_cfg)
+    else:
+        hf_cfg = FalconConfig(
+            vocab_size=vocab, hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_kv_heads=cfg.num_attention_heads_kv,
+            new_decoder_architecture=cfg.parallel_layernorm,
+            multi_query=cfg.num_attention_heads_kv == 1,
+            parallel_attn=True, bias=False, alibi=False,
+            rope_theta=cfg.rope_theta,
+        )
+        hf = FalconForCausalLM(hf_cfg)
+    missing, unexpected = hf.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
+        strict=False,
+    )
+    # only a tied lm_head (shared tensor) may legitimately be absent —
+    # anything else would silently export random init
+    assert set(missing) <= {"lm_head.weight"}, missing
+    assert not unexpected, unexpected
+    hf.save_pretrained(args.output, safe_serialization=True)
+    print(f"wrote HF checkpoint to {args.output}", flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", choices=["llama", "falcon"], required=True)
+    p.add_argument(
+        "--direction", choices=["hf2native", "native2hf"], required=True
+    )
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument(
+        "--true_vocab_size", type=int, default=None,
+        help="unpadded vocab for native2hf (ref: checkpoint_util --true_vocab_size)",
+    )
+    args = p.parse_args()
+    if args.direction == "hf2native":
+        hf2native(args)
+    else:
+        native2hf(args)
+
+
+if __name__ == "__main__":
+    main()
