@@ -74,7 +74,7 @@ def grouped_attention(
             scores = jnp.where(mask[:, :, None], neg, scores)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    if not deterministic and cfg.attention_dropout > 0.0:
+    if not deterministic and cfg.attention_dropout > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(
             dropout_rng, 1.0 - cfg.attention_dropout, probs.shape
         )
@@ -142,7 +142,13 @@ def attention_block(
         if rope_table is not None:
             q = apply_rope(q, rope_table, position_ids)
             k = apply_rope(k, rope_table, position_ids)
-        if cfg.use_flash_attn and mask is None:
+        # flash path has no dropout support: fall back to the grouped path
+        # when attention dropout is live (ADVICE r1; the reference's
+        # FlashSelfAttention passes dropout to the CUDA kernel instead)
+        flash_ok = cfg.use_flash_attn and mask is None and (
+            deterministic or cfg.attention_dropout == 0.0
+        )
+        if flash_ok:
             from megatron_llm_tpu.ops.flash_attention import flash_attention
 
             ctx = flash_attention(q, k, v, causal=True)

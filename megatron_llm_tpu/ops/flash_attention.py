@@ -1,16 +1,25 @@
-"""Flash attention — the flagship Pallas kernel of the build.
+"""Flash attention — the flagship Pallas kernel of the build. Fwd + bwd.
 
 Replaces the reference's external FlashAttention-2 dependency
-(ref: requirements.txt:3, transformer.py:508-523) and the three fused
-softmax CUDA kernels (ref: megatron/fused_kernels/scaled_*softmax*). The
-kernel is GQA/MQA-aware: K/V stay at `num_query_groups` heads and are never
+(ref: requirements.txt:3, transformer.py:508-523 — the reference TRAINS
+through flash-attn, so the backward here is load-bearing) and the three
+fused softmax CUDA kernels (ref: megatron/fused_kernels/scaled_*softmax*).
+
+GQA/MQA-aware: K/V stay at `num_query_groups` heads and are never
 broadcast-expanded (the reference expands them, transformer.py:449-456).
-
 Layout: q (b, s, g, qpk, d), k/v (b, t, g, d) — the grouped layout used
-throughout megatron_llm_tpu.models.attention.
+throughout megatron_llm_tpu.models.attention. Inside the kernels the
+(position, q-head) pair is folded into one row dim (head fastest), so one
+MXU matmul serves all q heads of a group.
 
-`flash_attention` dispatches to the Pallas kernel on TPU and to a
-numerically identical XLA fallback elsewhere (CPU tests, interpret mode).
+Backward follows the FlashAttention-2 recomputation scheme: the forward
+saves only O and the per-row logsumexp; the backward recomputes the score
+blocks and accumulates dq (grid over q blocks) and dk/dv (grid over k
+blocks) in fp32 VMEM scratch, with delta = rowsum(dO * O) precomputed.
+
+`flash_attention` dispatches to the Pallas kernels on TPU and to a
+numerically identical XLA fallback elsewhere; `interpret=True` runs the
+real kernels through the Pallas interpreter (used by the CPU test suite).
 """
 
 from __future__ import annotations
@@ -21,6 +30,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+# cap on folded (position, head) rows per program so fp32 score blocks
+# (rows x block_k) and the accumulators fit VMEM (~16 MB)
+MAX_ROWS = 2048
 
 
 def _xla_reference(q, k, v, causal: bool):
@@ -38,27 +55,103 @@ def _xla_reference(q, k, v, causal: bool):
     return jnp.einsum("bgqst,btgd->bsgqd", probs, v)
 
 
+def _choose_block(size: int, requested: int, qpk: int = 1):
+    """Largest power-of-2 block <= requested that divides `size` and keeps
+    folded rows (block*qpk) under MAX_ROWS. None if nothing fits (caller
+    falls back to the XLA path). Power-of-2 keeps Mosaic tile alignment
+    (sublane multiples of 8/16)."""
+    b = 1 << (min(requested, size).bit_length() - 1)  # round down to pow2
+    while b >= 8 and (size % b or b * qpk > MAX_ROWS):
+        b //= 2
+    return b if b >= 8 and size % b == 0 else None
+
+
+def _masked_scores(q_ref, k_ref, i, j, *, causal, block_q, block_k, qpk, d,
+                   sm_scale):
+    """Recompute the scaled, causal-masked score block — the ONE definition
+    shared by the forward and both backward kernels so fwd probabilities and
+    bwd recompute can never desynchronize. Returns (rows, block_k) fp32."""
+    rows = block_q * qpk
+    qb = q_ref[:].reshape(rows, d)
+    kb = k_ref[:].reshape(block_k, d)
+    sc = jax.lax.dot_general(
+        qb, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale
+    if causal:
+        q_pos = i * block_q + (
+            jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0) // qpk
+        )
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 1
+        )
+        sc = jnp.where(k_pos > q_pos, NEG_INF, sc)
+    return sc
+
+
 # ---------------------------------------------------------------------------
-# Pallas kernel
+# Forward kernel
 # ---------------------------------------------------------------------------
-# Online-softmax tiling: grid over (batch*group, q_block); each program
-# streams K/V blocks with running (max, sum, acc) in fp32 VMEM scratch.
-
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# Online-softmax tiling: grid over (batch*group, q_block, k_block); running
+# (max, sum, acc) in fp32 VMEM scratch; emits O and the logsumexp rows.
 
 
-def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int):
-    """q: (b, s, g, qpk, d); k,v: (b, t, g, d)."""
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, causal, block_q, block_k, qpk, d, num_k_blocks, sm_scale):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        # skip fully-masked K blocks (k block start > last q position)
+        run = (j * block_k) <= (i * block_q + block_q - 1)
+    else:
+        run = j >= 0  # always true, but traced
+
+    @pl.when(run)
+    def _compute():
+        # rows: (pos, head), head fastest
+        sc = _masked_scores(
+            q_ref, k_ref, i, j, causal=causal, block_q=block_q,
+            block_k=block_k, qpk=qpk, d=d, sm_scale=sm_scale,
+        )
+        m_prev = m_scr[:]  # (rows, 1)
+        m_cur = jnp.max(sc, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sc - m_new)  # (rows, block_k)
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[:].reshape(block_k, d),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[:] = (acc_scr[:] / l).astype(o_ref.dtype).reshape(
+            1, block_q, qpk * d
+        )
+        # rows-major (rows, 1) layout: Mosaic can't shape-cast the lane dim
+        # into sublanes, so lse lives as (bg, s*qpk, 1) end to end
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret=False):
+    """q: (b, s, g, qpk, d); k,v: (b, t, g, d).
+    Returns (o (b,s,g,qpk,d), lse (b*g, s*qpk, 1) fp32 rows-major)."""
     b, s, g, qpk, d = q.shape
     t = k.shape[1]
     sm_scale = 1.0 / (d ** 0.5)
-    block_q = min(block_q, s)
-    block_k = min(block_k, t)
     assert s % block_q == 0 and t % block_k == 0
 
-    # (b*g, s, qpk, d) -> (bg, s*qpk rows? ) — keep (bg, s, qpk, d); fold qpk
-    # into the row dim per q-block inside the kernel via reshape.
     qf = q.transpose(0, 2, 1, 3, 4).reshape(b * g, s, qpk * d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * g, t, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * g, t, d)
@@ -66,62 +159,12 @@ def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int):
     num_q_blocks = s // block_q
     num_k_blocks = t // block_k
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
-        i = pl.program_id(1)
-        j = pl.program_id(2)
-
-        @pl.when(j == 0)
-        def _init():
-            m_scr[:] = jnp.full_like(m_scr, -1e30)
-            l_scr[:] = jnp.zeros_like(l_scr)
-            acc_scr[:] = jnp.zeros_like(acc_scr)
-
-        if causal:
-            # skip fully-masked K blocks (k block start > last q position)
-            run = (j * block_k) <= (i * block_q + block_q - 1)
-        else:
-            run = j >= 0  # always true, but traced
-
-        @pl.when(run)
-        def _compute():
-            qb = q_ref[:].reshape(block_q * qpk, d)  # rows: (pos, head), head fastest
-            kb = k_ref[:].reshape(block_k, d)
-            sc = jax.lax.dot_general(
-                qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * sm_scale  # (rows, block_k)
-
-            if causal:
-                q_pos = i * block_q + (
-                    jax.lax.broadcasted_iota(jnp.int32, (block_q * qpk, block_k), 0)
-                    // qpk
-                )
-                k_pos = j * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q * qpk, block_k), 1
-                )
-                sc = jnp.where(k_pos > q_pos, -1e30, sc)
-
-            m_prev = m_scr[:]  # (rows, 1)
-            m_cur = jnp.max(sc, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(sc - m_new)  # (rows, block_k)
-            l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
-            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-                p.astype(v_ref.dtype), v_ref[:].reshape(block_k, d),
-                preferred_element_type=jnp.float32,
-            )
-            m_scr[:] = m_new
-            l_scr[:] = l_new
-
-        @pl.when(j == num_k_blocks - 1)
-        def _finalize():
-            o_ref[:] = (
-                acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
-            ).astype(o_ref.dtype).reshape(1, block_q, qpk * d)
-
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        qpk=qpk, d=d, num_k_blocks=num_k_blocks, sm_scale=sm_scale,
+    )
     grid = (b * g, num_q_blocks, num_k_blocks)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -129,19 +172,229 @@ def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int):
             pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * g, s, qpk * d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q * qpk, 1), lambda h, i, j: (h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * g, s, qpk * d), q.dtype),
+            jax.ShapeDtypeStruct((b * g, s * qpk, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q * qpk, 1), jnp.float32),
             pltpu.VMEM((block_q * qpk, 1), jnp.float32),
             pltpu.VMEM((block_q * qpk, d), jnp.float32),
         ],
+        interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4)
+    return out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4), lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 recomputation scheme)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_scr, *, causal, block_q, block_k, qpk, d,
+                   num_k_blocks, sm_scale):
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # k block
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = ((j * block_k) <= (i * block_q + block_q - 1)) if causal else j >= 0
+
+    @pl.when(run)
+    def _compute():
+        rows = block_q * qpk
+        kb = k_ref[:].reshape(block_k, d)
+        vb = v_ref[:].reshape(block_k, d)
+        dob = do_ref[:].reshape(rows, d)
+
+        sc = _masked_scores(
+            q_ref, k_ref, i, j, causal=causal, block_q=block_q,
+            block_k=block_k, qpk=qpk, d=d, sm_scale=sm_scale,
+        )
+        p = jnp.exp(sc - lse_ref[0])  # exact probs via saved logsumexp
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        acc_scr[:] = acc_scr[:] + jax.lax.dot(
+            ds.astype(kb.dtype), kb, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[:] = (acc_scr[:] * sm_scale).astype(dq_ref.dtype).reshape(
+            1, block_q, qpk * d
+        )
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, block_q,
+                    block_k, qpk, d, num_q_blocks, sm_scale):
+    j = pl.program_id(1)  # k block
+    i = pl.program_id(2)  # q block
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: q blocks strictly before this k block contribute nothing
+    run = ((i * block_q + block_q - 1) >= (j * block_k)) if causal else i >= 0
+
+    @pl.when(run)
+    def _compute():
+        rows = block_q * qpk
+        qb = q_ref[:].reshape(rows, d)
+        vb = v_ref[:].reshape(block_k, d)
+        dob = do_ref[:].reshape(rows, d)
+
+        sc = _masked_scores(
+            q_ref, k_ref, i, j, causal=causal, block_q=block_q,
+            block_k=block_k, qpk=qpk, d=d, sm_scale=sm_scale,
+        )
+        p = jnp.exp(sc - lse_ref[0])  # (rows, block_k)
+        # dv += P^T dO
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        # dk += dS^T Q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[:] = (dk_scr[:] * sm_scale).astype(dk_ref.dtype).reshape(
+            1, block_k, d
+        )
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype).reshape(1, block_k, d)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
+                      interpret=False):
+    b, s, g, qpk, d = q.shape
+    t = k.shape[1]
+    sm_scale = 1.0 / (d ** 0.5)
+
+    qf = q.transpose(0, 2, 1, 3, 4).reshape(b * g, s, qpk * d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * g, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * g, t, d)
+    dof = do.transpose(0, 2, 1, 3, 4).reshape(b * g, s, qpk * d)
+    # delta = rowsum(dO * O) — one fused elementwise reduce, XLA does this
+    # as well as a kernel would (ref FA2 preprocess step); rows-major layout
+    # matching lse
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1, 3).reshape(b * g, s * qpk, 1)
+
+    num_q_blocks = s // block_q
+    num_k_blocks = t // block_k
+
+    row_specs = [
+        pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),        # k
+        pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),        # v
+        pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),  # do
+        pl.BlockSpec((1, block_q * qpk, 1), lambda h, i, j: (h, i, 0)),  # lse
+        pl.BlockSpec((1, block_q * qpk, 1), lambda h, i, j: (h, i, 0)),  # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
+            qpk=qpk, d=d, num_k_blocks=num_k_blocks, sm_scale=sm_scale,
+        ),
+        grid=(b * g, num_q_blocks, num_k_blocks),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * g, s, qpk * d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q * qpk, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    col_specs = [
+        pl.BlockSpec((1, block_q, qpk * d), lambda h, j, i: (h, i, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),        # k
+        pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),        # v
+        pl.BlockSpec((1, block_q, qpk * d), lambda h, j, i: (h, i, 0)),  # do
+        pl.BlockSpec((1, block_q * qpk, 1), lambda h, j, i: (h, i, 0)),  # lse
+        pl.BlockSpec((1, block_q * qpk, 1), lambda h, j, i: (h, i, 0)),  # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
+            qpk=qpk, d=d, num_q_blocks=num_q_blocks, sm_scale=sm_scale,
+        ),
+        grid=(b * g, num_k_blocks, num_q_blocks),
+        in_specs=col_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * g, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * g, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dq = dq.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4)
+    dk = dk.reshape(b, g, t, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, g, t, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (ref parity: training THROUGH flash attention,
+# transformer.py:508-523)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(config, q, k, v):
+    causal, block_q, block_k, interpret = config
+    o, _ = _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd_rule(config, q, k, v):
+    causal, block_q, block_k, interpret = config
+    o, lse = _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(config, residuals, g):
+    causal, block_q, block_k, interpret = config
+    q, k, v, o, lse = residuals
+    return _flash_bwd_pallas(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+    )
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "use_pallas",
-                                             "block_q", "block_k"))
+                                             "block_q", "block_k",
+                                             "interpret"))
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -150,14 +403,16 @@ def flash_attention(
     use_pallas: bool | None = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
 ) -> jnp.ndarray:
-    """GQA flash attention. Returns (b, s, g, qpk, d)."""
+    """GQA flash attention, differentiable. Returns (b, s, g, qpk, d)."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         s, t, d = q.shape[1], k.shape[1], q.shape[-1]
-        bq = min(block_q, s)
-        bk = min(block_k, t)
-        if s % bq == 0 and t % bk == 0 and d % 128 == 0:
-            return _flash_fwd_pallas(q, k, v, causal, bq, bk)
+        qpk = q.shape[3]
+        bq = _choose_block(s, block_q, qpk)
+        bk = _choose_block(t, block_k)
+        if bq is not None and bk is not None and d % 128 == 0:
+            return _flash((causal, bq, bk, interpret), q, k, v)
     return _xla_reference(q, k, v, causal)
