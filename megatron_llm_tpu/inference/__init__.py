@@ -1,0 +1,10 @@
+from megatron_llm_tpu.inference.api import (  # noqa: F401
+    beam_search_and_post_process,
+    generate_and_post_process,
+)
+from megatron_llm_tpu.inference.generation import (  # noqa: F401
+    beam_search,
+    generate_tokens,
+    score_tokens,
+)
+from megatron_llm_tpu.inference.sampling import sample  # noqa: F401

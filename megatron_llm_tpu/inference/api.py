@@ -1,0 +1,149 @@
+"""Public inference API.
+
+Parity target: ref megatron/text_generation/api.py —
+`generate_and_post_process` (:19), `generate` (:70) and
+`beam_search_and_post_process` (:147). The reference's sampling-parameter
+broadcast from rank 0 (:100-127) disappears: one controller drives the
+mesh.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from megatron_llm_tpu.inference.generation import (
+    beam_search,
+    generate_tokens,
+    score_tokens,
+)
+from megatron_llm_tpu.inference.tokenization import (
+    detokenize_generations,
+    tokenize_prompts,
+)
+
+
+def generate_and_post_process(
+    model,
+    params,
+    tokenizer,
+    prompts: List[str],
+    tokens_to_generate: int = 0,
+    return_output_log_probs: bool = False,
+    top_k_sampling: int = 0,
+    top_p_sampling: float = 0.0,
+    top_p_decay: float = 0.0,
+    top_p_bound: float = 0.0,
+    temperature: float = 1.0,
+    add_BOS: bool = False,
+    use_eod_token_for_early_termination: bool = True,
+    stop_on_eol: bool = False,  # accepted for API parity; eol ids are
+    stop_on_double_eol: bool = False,  # tokenizer-specific (ref TODO :243)
+    prevent_newline_after_colon: bool = False,
+    random_seed: int = -1,
+):
+    """Returns (prompts_plus_generations, segments, output_log_probs,
+    tokens) — the reference's return contract (api.py:19-67)."""
+    tokens, lengths = tokenize_prompts(
+        tokenizer, prompts, tokens_to_generate, add_BOS
+    )
+
+    if tokens_to_generate == 0:
+        # score-only mode (ref: api.py:48-56 -> score_and_return...)
+        lp = np.asarray(score_tokens(model, params, tokens))
+        texts, segments = detokenize_generations(
+            tokenizer, tokens, lengths, return_segments=True
+        )
+        return texts, segments, lp, tokens
+
+    pnac_ids = None
+    if prevent_newline_after_colon:
+        colon = tokenizer.tokenize(":")
+        newline = tokenizer.tokenize("\n")
+        if colon and newline:
+            pnac_ids = (colon[0], newline[0])
+
+    rng = None
+    if top_k_sampling != 1:
+        seed = random_seed if random_seed >= 0 else 0
+        rng = jax.random.key(seed)
+
+    # prefill the longest common multiple-of-64 prefix; the rest of each
+    # prompt is teacher-forced by the decode loop (bounded compile shapes)
+    min_len = int(np.min(lengths))
+    prefill_len = max(1, (min_len // 64) * 64) if min_len >= 64 else min_len
+
+    out = generate_tokens(
+        model,
+        params,
+        tokens,
+        lengths,
+        prefill_len=prefill_len,
+        rng=rng,
+        top_k=top_k_sampling,
+        top_p=top_p_sampling,
+        top_p_decay=top_p_decay,
+        top_p_bound=top_p_bound,
+        temperature=temperature,
+        vocab_size=tokenizer.vocab_size,
+        termination_id=tokenizer.eod,
+        return_log_probs=return_output_log_probs,
+        use_eod_for_early_termination=use_eod_token_for_early_termination,
+        prevent_newline_after_colon_ids=pnac_ids,
+    )
+    out_tokens = np.asarray(out.tokens)
+    out_lengths = np.minimum(np.asarray(out.lengths),
+                             lengths + tokens_to_generate)
+    texts, segments = detokenize_generations(
+        tokenizer, out_tokens, out_lengths, return_segments=True
+    )
+    lp = np.asarray(out.log_probs) if out.log_probs is not None else None
+    return texts, segments, lp, out_tokens
+
+
+def beam_search_and_post_process(
+    model,
+    params,
+    tokenizer,
+    prompts: List[str],
+    tokens_to_generate: int = 0,
+    beam_size: int = 0,
+    add_BOS: bool = False,
+    stop_token: Optional[int] = None,
+    num_return_gen: int = 1,
+    length_penalty: float = 1.0,
+    prevent_newline_after_colon: bool = False,
+):
+    """ref: beam_search_and_post_process (api.py:147-201)."""
+    assert len(prompts) == 1, "beam search: batch size must be 1"
+    tokens, lengths = tokenize_prompts(
+        tokenizer, prompts, tokens_to_generate, add_BOS
+    )
+    stop = stop_token if stop_token is not None else tokenizer.eod
+    out_tokens, scores = beam_search(
+        model,
+        params,
+        tokens[:1],
+        prompt_length=int(lengths[0]),
+        beam_size=beam_size,
+        stop_token=stop,
+        num_return_gen=num_return_gen,
+        length_penalty=length_penalty,
+        vocab_size=tokenizer.vocab_size,
+    )
+    out_tokens = np.asarray(out_tokens)
+    out_lengths = np.full((out_tokens.shape[0],), out_tokens.shape[1],
+                          np.int32)
+    # trim trailing stop padding per row
+    for i in range(out_tokens.shape[0]):
+        row = out_tokens[i]
+        n = len(row)
+        while n > int(lengths[0]) and row[n - 1] == stop:
+            n -= 1
+        out_lengths[i] = n
+    texts, segments = detokenize_generations(
+        tokenizer, out_tokens, out_lengths, return_segments=True
+    )
+    return texts, segments, np.asarray(scores), out_tokens

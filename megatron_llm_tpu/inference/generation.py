@@ -1,0 +1,364 @@
+"""Autoregressive generation engine: scoring, sampling decode, beam search.
+
+Parity target: ref megatron/text_generation/generation.py —
+`score_and_return_on_first_stage` (:20), the incremental KV-cached decode
+loop `generate_tokens_probs_and_return_on_first_stage` (:89-286) and
+`beam_search_and_return_on_first_stage` (:288-429).
+
+TPU-first structure: the reference drives a per-token Python loop issuing
+one forward per context length with pipeline broadcasts between stages.
+Here the whole decode is ONE jitted program: a prefill forward over the
+common prompt prefix, then a `lax.while_loop` over single-token steps
+against the preallocated KV cache — token selection, teacher-forcing of
+still-in-prompt rows, logprob gathering and eod early-termination all live
+inside the loop, so there is no per-token host round-trip. The pipeline
+broadcast machinery (ref text_generation/communication.py) has no
+analogue: under GSPMD the logits land wherever the sampling runs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.inference.sampling import (
+    NEG_INF,
+    modify_logits_for_top_k,
+    modify_logits_for_top_p,
+)
+
+
+class GenerateOutput(NamedTuple):
+    tokens: jnp.ndarray  # (b, max_len) prompt + generated
+    lengths: jnp.ndarray  # (b,) total generated length incl. prompt
+    log_probs: Optional[jnp.ndarray]  # (b, max_len - 1) fp32 or None
+
+
+def score_tokens(model, params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Log-probs of each provided next token (ref:
+    score_and_return_on_first_stage generation.py:20-86).
+    Returns (b, s-1): lp[:, i] = log P(tokens[:, i+1] | tokens[:, :i+1])."""
+    logits, _ = model.forward(params, tokens[:, :-1])
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        lp, tokens[:, 1:, None].astype(jnp.int32), axis=-1
+    ).squeeze(-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "model", "prefill_len", "top_k", "top_p", "temperature",
+        "vocab_size", "termination_id", "return_log_probs",
+        "use_eod_for_early_termination", "top_p_decay", "top_p_bound",
+        "prevent_newline_after_colon_ids",
+    ),
+)
+def generate_tokens(
+    model,
+    params,
+    tokens: jnp.ndarray,  # (b, max_len) int32, prompts left-aligned + padded
+    lengths: jnp.ndarray,  # (b,) prompt lengths
+    prefill_len: int,  # static; <= min(lengths), >= 1
+    rng: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    top_p_decay: float = 0.0,
+    top_p_bound: float = 0.0,
+    temperature: float = 1.0,
+    vocab_size: Optional[int] = None,
+    termination_id: Optional[int] = None,
+    return_log_probs: bool = False,
+    use_eod_for_early_termination: bool = True,
+    prevent_newline_after_colon_ids: Optional[Tuple[int, int]] = None,
+) -> GenerateOutput:
+    """The main generation function (ref: generation.py:89-286).
+
+    Rows whose prompt extends past the current position are teacher-forced
+    (ref :209-211 `started` mask); generation for a row starts at its own
+    prompt end. Decode runs until max_len or until every started row has
+    emitted `termination_id` (ref :239-263).
+    """
+    b, max_len = tokens.shape
+    tokens = tokens.astype(jnp.int32)
+    greedy = top_k == 1 or rng is None
+    if rng is None:
+        rng = jax.random.key(0)  # unused on the greedy path
+
+    caches = model.init_kv_caches(b, max_len)
+
+    log_probs = jnp.zeros((b, max_len - 1), jnp.float32)
+
+    def gather_lp(logits, targets):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.take_along_axis(lp, targets[..., None], axis=-1).squeeze(-1)
+
+    # ---- prefill the common prefix (one big causal forward) --------------
+    logits, caches = model.forward(
+        params, tokens[:, :prefill_len], kv_caches=caches
+    )
+    if return_log_probs:
+        # positions 0..prefill_len-2 predict tokens 1..prefill_len-1
+        log_probs = jax.lax.dynamic_update_slice(
+            log_probs, gather_lp(logits[:, :-1], tokens[:, 1:prefill_len]),
+            (0, 0),
+        )
+    last_logits = logits[:, -1]  # predicts position prefill_len
+
+    def select_token(logits, t, prev_token, step_rng, cur_top_p):
+        logits = logits.astype(jnp.float32)
+        if prevent_newline_after_colon_ids is not None:
+            # ref :191: disable "\n" right after ":"
+            colon_id, newline_id = prevent_newline_after_colon_ids
+            hit = prev_token == colon_id
+            logits = jnp.where(
+                hit[:, None]
+                & (jnp.arange(logits.shape[-1]) == newline_id)[None, :],
+                NEG_INF, logits,
+            )
+        if vocab_size is not None and vocab_size < logits.shape[-1]:
+            pad = jnp.arange(logits.shape[-1]) >= vocab_size
+            logits = jnp.where(pad[None, :], NEG_INF, logits)
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if temperature != 1.0:
+            logits = logits / temperature
+        if top_k > 1:
+            logits = modify_logits_for_top_k(logits, top_k)
+        elif top_p > 0.0:
+            logits = modify_logits_for_top_p(logits, cur_top_p)
+        return jax.random.categorical(step_rng, logits, axis=-1).astype(jnp.int32)
+
+    # ---- single-token decode steps ---------------------------------------
+    # carry: (t, tokens, caches, last_logits, log_probs, done, gen_lengths,
+    #         cur_top_p)
+    def cond(carry):
+        t, _, _, _, _, done, _, _ = carry
+        keep_going = t < max_len
+        if use_eod_for_early_termination and termination_id is not None:
+            keep_going &= ~jnp.all(done)
+        return keep_going
+
+    def body(carry):
+        t, toks, caches, last_logits, lps, done, gen_lens, cur_top_p = carry
+        step_rng = jax.random.fold_in(rng, t)
+        prev_token = jax.lax.dynamic_index_in_dim(toks, t - 1, axis=1,
+                                                  keepdims=False)
+        new_sample = select_token(last_logits, t, prev_token, step_rng,
+                                  cur_top_p)
+        started = lengths <= t  # ref :209 — past this row's prompt?
+        prompt_tok = jax.lax.dynamic_index_in_dim(toks, t, axis=1,
+                                                  keepdims=False)
+        chosen = jnp.where(started, new_sample, prompt_tok)
+        toks = jax.lax.dynamic_update_slice(toks, chosen[:, None], (0, t))
+
+        if return_log_probs:
+            lps = jax.lax.dynamic_update_slice(
+                lps, gather_lp(last_logits, chosen)[:, None], (0, t - 1)
+            )
+
+        # eod bookkeeping (ref :239-263)
+        if termination_id is not None:
+            done_token = (chosen == termination_id) & started
+            just_finished = done_token & ~done
+            gen_lens = jnp.where(just_finished, t + 1, gen_lens)
+            done = done | done_token
+
+        if top_p > 0.0 and top_p_decay > 0.0:
+            cur_top_p = jnp.maximum(cur_top_p * top_p_decay,
+                                    top_p_bound)
+
+        # next step's logits from the KV-cached single-token forward
+        logits, caches = model.forward(
+            params, chosen[:, None], kv_caches=caches
+        )
+        return (t + 1, toks, caches, logits[:, -1], lps, done, gen_lens,
+                cur_top_p)
+
+    carry = (
+        jnp.asarray(prefill_len, jnp.int32),
+        tokens,
+        caches,
+        last_logits,
+        log_probs,
+        jnp.zeros((b,), bool),
+        jnp.full((b,), max_len, jnp.int32),
+        jnp.float32(top_p),
+    )
+    _, tokens, _, _, log_probs, _, gen_lens, _ = jax.lax.while_loop(
+        cond, body, carry
+    )
+    return GenerateOutput(
+        tokens=tokens,
+        lengths=gen_lens,
+        log_probs=log_probs if return_log_probs else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Beam search (ref: beam_search_and_return_on_first_stage generation.py:288
+# + BeamHypotheses beam_utils.py:19)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("beam_size", "vocab_size"))
+def _beam_step(params, last_logits, scores, beam_size, vocab_size):
+    """Top 2*beam (score, flat-index) candidates (ref: generation.py:336-357).
+    Module-level so repeated beam_search calls hit the jit cache."""
+    lp = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+    if vocab_size is not None and vocab_size < lp.shape[-1]:
+        pad = jnp.arange(lp.shape[-1]) >= vocab_size
+        lp = jnp.where(pad[None, :], NEG_INF, lp)
+    total = lp + scores[:, None]  # (beam, V)
+    return jax.lax.top_k(total.reshape(-1), 2 * beam_size)
+
+
+@functools.partial(jax.jit, static_argnames=("model",), donate_argnums=(3,))
+def _beam_advance(model, params, toks, caches, beam_idx, token_idx, t):
+    """Reorder beams, bank the chosen tokens, run one KV-cached step
+    (ref: generation.py:359-398 beam reorder + forward)."""
+    toks = jnp.take(toks, beam_idx, axis=0)
+    caches = jax.tree.map(
+        lambda c: jnp.take(c, beam_idx, axis=1) if c.ndim >= 2 else c, caches
+    )
+    toks = jax.lax.dynamic_update_slice(
+        toks, token_idx[:, None].astype(jnp.int32), (0, t)
+    )
+    logits, caches = model.forward(
+        params, token_idx[:, None].astype(jnp.int32), kv_caches=caches
+    )
+    return toks, caches, logits[:, -1]
+
+
+class BeamHypotheses:
+    """Sorted pool of finished hypotheses (ref: beam_utils.py:19-60)."""
+
+    def __init__(self, num_beams: int, length_penalty: float = 1.0,
+                 early_stopping: bool = False):
+        self.num_beams = num_beams
+        self.length_penalty = length_penalty
+        self.early_stopping = early_stopping
+        self.beams: list = []
+        self.worst_score = 1e9
+
+    def __len__(self):
+        return len(self.beams)
+
+    def add(self, hyp, sum_logprobs: float):
+        score = sum_logprobs / max(len(hyp), 1) ** self.length_penalty
+        if len(self) < self.num_beams or score > self.worst_score:
+            self.beams.append((score, hyp))
+            if len(self) > self.num_beams:
+                sorted_scores = sorted(
+                    (s, idx) for idx, (s, _) in enumerate(self.beams)
+                )
+                del self.beams[sorted_scores[0][1]]
+                self.worst_score = sorted_scores[1][0]
+            else:
+                self.worst_score = min(score, self.worst_score)
+
+    def is_done(self, best_sum_logprobs: float, cur_len: int) -> bool:
+        if len(self) < self.num_beams:
+            return False
+        if self.early_stopping:
+            return True
+        return self.worst_score >= (
+            best_sum_logprobs / cur_len ** self.length_penalty
+        )
+
+
+def beam_search(
+    model,
+    params,
+    tokens: jnp.ndarray,  # (1, max_len) prompt + padding
+    prompt_length: int,
+    beam_size: int,
+    stop_token: int,
+    num_return_gen: int = 1,
+    length_penalty: float = 1.0,
+    vocab_size: Optional[int] = None,
+):
+    """Batch-1 beam search (the reference asserts batch==1 too,
+    generation.py:295). Host loop over positions with jitted single-token
+    steps; beam bookkeeping mirrors BeamHypotheses.
+
+    Returns (tokens (num_return_gen, out_len), scores (num_return_gen,)).
+    """
+    import numpy as np
+
+    assert tokens.shape[0] == 1, "beam search: batch size must be 1"
+    max_len = tokens.shape[1]
+    tokens = jnp.broadcast_to(tokens, (beam_size,) + tokens.shape[1:]).astype(
+        jnp.int32
+    )
+
+    caches = model.init_kv_caches(beam_size, max_len)
+    logits, caches = model.forward(
+        params, tokens[:, :prompt_length], kv_caches=caches
+    )
+    last_logits = logits[:, -1]
+
+    def step(params, last_logits, scores):
+        return _beam_step(params, last_logits, scores, beam_size, vocab_size)
+
+    def advance(params, toks, caches, beam_idx, token_idx, t):
+        return _beam_advance(
+            model, params, toks, caches, beam_idx, token_idx, t
+        )
+
+    vocab = last_logits.shape[-1]
+    scores = jnp.concatenate(
+        [jnp.zeros((1,)), jnp.full((beam_size - 1,), NEG_INF)]
+    )  # first step: all beams identical, only beam 0 counts (ref :330-334)
+    hyps = BeamHypotheses(beam_size, length_penalty)
+    done = False
+
+    for t in range(prompt_length, max_len):
+        best_scores, best_idx = step(params, last_logits, scores)
+        best_scores = np.asarray(best_scores)
+        best_idx = np.asarray(best_idx)
+
+        next_beams = []  # (score, beam, token)
+        for sc, idx in zip(best_scores, best_idx):
+            beam, tok = divmod(int(idx), vocab)
+            if tok == stop_token:
+                hyp = np.asarray(tokens[beam, prompt_length:t])
+                hyps.add(hyp, float(sc))
+            else:
+                next_beams.append((float(sc), beam, tok))
+            if len(next_beams) == beam_size:
+                break
+        if hyps.is_done(float(best_scores[0]), t - prompt_length + 1):
+            done = True
+            break
+        if not next_beams:
+            break
+        beam_idx = jnp.asarray([b for _, b, _ in next_beams], jnp.int32)
+        token_idx = jnp.asarray([tk for _, _, tk in next_beams], jnp.int32)
+        scores = jnp.asarray([s for s, _, _ in next_beams], jnp.float32)
+        tokens, caches, last_logits = advance(
+            params, tokens, caches, beam_idx, token_idx, t
+        )
+
+    if not done:
+        # out of length: finalize open beams (ref :402-407)
+        for i in range(beam_size):
+            hyp = np.asarray(tokens[i, prompt_length:max_len])
+            hyps.add(hyp, float(scores[i]))
+
+    best = sorted(hyps.beams, key=lambda x: -x[0])[:num_return_gen]
+    prompt = np.asarray(tokens[0, :prompt_length])
+    out_tokens = []
+    out_scores = []
+    for score, hyp in best:
+        seq = np.concatenate([prompt, np.asarray(hyp, np.int32)])
+        out_tokens.append(seq)
+        out_scores.append(score)
+    pad_to = max(len(s) for s in out_tokens)
+    out = np.full((len(out_tokens), pad_to), stop_token, np.int32)
+    for i, s in enumerate(out_tokens):
+        out[i, : len(s)] = s
+    return jnp.asarray(out), jnp.asarray(out_scores, jnp.float32)

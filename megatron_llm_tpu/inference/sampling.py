@@ -1,0 +1,73 @@
+"""Token sampling: greedy, top-k, top-p, temperature.
+
+Parity target: ref megatron/text_generation/sampling.py:14-93 — including
+the top-p filter's one-position shift (keep the first token whose
+cumulative probability crosses top_p, ref :30-38) and the padded-vocab
+clamp. All jnp, shapes static, usable inside jitted decode loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10  # matches the reference's masked_fill value semantics
+
+
+def modify_logits_for_top_k(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Keep only the top-k logits (ref :14-18). `top_k` is static."""
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1, None]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def modify_logits_for_top_p(logits: jnp.ndarray, top_p) -> jnp.ndarray:
+    """Nucleus filtering (ref :22-41), including the shift-by-1 that keeps
+    the first token crossing the cumulative-probability boundary."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_indices = jnp.argsort(logits, axis=-1)[..., ::-1]
+    cum_probs = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    filt = cum_probs > top_p
+    filt = jnp.concatenate(
+        [jnp.zeros_like(filt[..., :1]), filt[..., :-1]], axis=-1
+    )  # ref :30-36: shift right, always keep rank 0
+    # scatter back to original vocab order via the inverse permutation
+    inv = jnp.argsort(sorted_indices, axis=-1)
+    filt = jnp.take_along_axis(filt, inv, axis=-1)
+    return jnp.where(filt, NEG_INF, logits)
+
+
+def sample(
+    logits: jnp.ndarray,  # (b, v) float
+    rng: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    temperature: float = 1.0,
+    vocab_size: Optional[int] = None,
+) -> jnp.ndarray:
+    """Sample one token per row (ref: sample :45-93). top_k=1 (or rng None)
+    is greedy argmax; top_k and top_p are mutually exclusive. `top_k`,
+    `top_p`, `temperature`, `vocab_size` are static."""
+    assert logits.ndim == 2
+    logits = logits.astype(jnp.float32)
+    if vocab_size is not None and vocab_size < logits.shape[-1]:
+        # never sample padded-vocab ids (ref :49-52 vocab_size guard)
+        pad = jnp.arange(logits.shape[-1]) >= vocab_size
+        logits = jnp.where(pad[None, :], NEG_INF, logits)
+
+    if top_k == 1 or rng is None:
+        assert top_p == 0.0 or rng is None, \
+            "cannot set both greedy and top-p samplings"
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    if temperature != 1.0:
+        logits = logits / temperature
+    if top_k > 1:
+        assert top_p == 0.0, "cannot set both top-k and top-p samplings"
+        assert top_k <= logits.shape[-1]
+        logits = modify_logits_for_top_k(logits, top_k)
+    elif top_p > 0.0:
+        assert 0.0 < top_p <= 1.0
+        logits = modify_logits_for_top_p(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,200 @@
+"""REST text-generation server, reference API contract.
+
+Parity target: ref megatron/text_generation_server.py — `MegatronGenerate`
+(PUT /api, :17-233, including every request-validation message) and
+`MegatronServer` (:234-241). The reference needs flask_restful plus a
+broadcast to wake the non-rank-0 GPU cohort (:22-29); the JAX build is
+single-controller, so a stdlib ThreadingHTTPServer with a generation lock
+replaces both (flask isn't in the image; the HTTP surface is identical).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from megatron_llm_tpu.inference.api import (
+    beam_search_and_post_process,
+    generate_and_post_process,
+)
+
+GENERATE_NUM = 0
+BEAM_NUM = 1
+LOCK = threading.Lock()
+
+
+class MegatronGenerate:
+    """Request validation + dispatch (ref: MegatronGenerate :17-233)."""
+
+    def __init__(self, model, params, tokenizer):
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+
+    def put(self, raw: dict):
+        """Returns (payload, http_status); validation messages mirror the
+        reference byte for byte where applicable."""
+        if "prompts" not in raw:
+            return "prompts argument required", 400
+        if "max_len" in raw:
+            return "max_len is no longer used.  Replace with tokens_to_generate", 400
+        if "sentences" in raw:
+            return "sentences is no longer used.  Replace with prompts", 400
+        prompts = raw["prompts"]
+        if not isinstance(prompts, list):
+            return "prompts is not a list of strings", 400
+        if len(prompts) == 0:
+            return "prompts is empty", 400
+        if len(prompts) > 128:
+            return "Maximum number of prompts is 128", 400
+
+        tokens_to_generate = raw.get("tokens_to_generate", 64)
+        if not isinstance(tokens_to_generate, int):
+            return "tokens_to_generate must be an integer greater than 0", 400
+        if tokens_to_generate < 0:
+            return ("tokens_to_generate must be an integer greater than or "
+                    "equal to 0"), 400
+
+        logprobs = raw.get("logprobs", False)
+        if not isinstance(logprobs, bool):
+            return "logprobs must be a boolean value", 400
+        if tokens_to_generate == 0 and not logprobs:
+            return "tokens_to_generate=0 implies logprobs should be True", 400
+
+        temperature = raw.get("temperature", 1.0)
+        if not isinstance(temperature, (int, float)) or not (
+            0.0 < temperature <= 100.0
+        ):
+            return ("temperature must be a positive number less than or "
+                    "equal to 100.0"), 400
+
+        top_k = raw.get("top_k", 0)
+        if not isinstance(top_k, int) or not (0 <= top_k <= 1000):
+            return "top_k must be an integer equal to or greater than 0 and less than or equal to 1000", 400
+
+        top_p = raw.get("top_p", 0.0)
+        if not isinstance(top_p, (int, float)) or not (0.0 <= top_p <= 1.0):
+            return "top_p must be less than or equal to 1 and greater than or equal to 0", 400
+        if top_p > 0.0 and top_k > 0:
+            return "cannot set both top-k and top-p samplings.", 400
+
+        top_p_decay = raw.get("top_p_decay", 0.0)
+        top_p_bound = raw.get("top_p_bound", 0.0)
+        add_BOS = raw.get("add_BOS", False)
+        if not isinstance(add_BOS, bool):
+            return "add_BOS must be a boolean value", 400
+        if any(len(p) == 0 for p in prompts) and not add_BOS:
+            return "Empty prompts require add_BOS=true", 400
+
+        stop_on_double_eol = raw.get("stop_on_double_eol", False)
+        stop_on_eol = raw.get("stop_on_eol", False)
+        prevent_newline_after_colon = raw.get(
+            "prevent_newline_after_colon", False
+        )
+        random_seed = raw.get("random_seed", -1)
+        no_log = raw.get("no_log", False)
+        beam_width = raw.get("beam_width", None)
+        stop_token = raw.get("stop_token", None)
+        length_penalty = raw.get("length_penalty", 1.0)
+
+        with LOCK:  # one generation at a time (ref :186)
+            try:
+                if beam_width is not None:
+                    if not isinstance(beam_width, int) or beam_width < 1:
+                        return "beam_width must be integer > 0", 400
+                    if len(prompts) > 1:
+                        return "When doing beam_search, batch size must be 1", 400
+                    texts, segments, scores, _ = beam_search_and_post_process(
+                        self.model, self.params, self.tokenizer, prompts,
+                        tokens_to_generate=tokens_to_generate,
+                        beam_size=beam_width,
+                        add_BOS=add_BOS,
+                        stop_token=stop_token,
+                        num_return_gen=beam_width,
+                        length_penalty=length_penalty,
+                        prevent_newline_after_colon=prevent_newline_after_colon,
+                    )
+                    return {
+                        "text": texts,
+                        "segments": segments,
+                        "scores": scores.tolist(),
+                    }, 200
+                texts, segments, lp, _ = generate_and_post_process(
+                    self.model, self.params, self.tokenizer, prompts,
+                    tokens_to_generate=tokens_to_generate,
+                    return_output_log_probs=logprobs,
+                    top_k_sampling=top_k,
+                    top_p_sampling=top_p,
+                    top_p_decay=top_p_decay,
+                    top_p_bound=top_p_bound,
+                    temperature=temperature,
+                    add_BOS=add_BOS,
+                    stop_on_eol=stop_on_eol,
+                    stop_on_double_eol=stop_on_double_eol,
+                    prevent_newline_after_colon=prevent_newline_after_colon,
+                    random_seed=random_seed,
+                )
+                return {
+                    "text": texts,
+                    "segments": segments,
+                    "logprobs": lp.tolist() if lp is not None else None,
+                }, 200
+            except Exception as e:  # ref returns jsonified error (:230)
+                return json.dumps({"message": repr(e)}), 500
+
+
+class _Handler(BaseHTTPRequestHandler):
+    generator: Optional[MegatronGenerate] = None
+
+    def do_PUT(self):
+        if self.path.rstrip("/") != "/api":
+            self.send_error(404)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            self._respond("invalid json", 400)
+            return
+        payload, status = self.generator.put(raw)
+        self._respond(payload, status)
+
+    def _respond(self, payload, status):
+        body = (json.dumps(payload) if isinstance(payload, (dict, list))
+                else json.dumps(payload))
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+
+class MegatronServer:
+    """ref: MegatronServer (text_generation_server.py:234-241)."""
+
+    def __init__(self, model, params, tokenizer):
+        self.generator = MegatronGenerate(model, params, tokenizer)
+        self._httpd = None
+
+    def run(self, host: str = "0.0.0.0", port: int = 5000,
+            block: bool = True):
+        handler = type("Handler", (_Handler,), {"generator": self.generator})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        if block:
+            self._httpd.serve_forever()
+        else:
+            t = threading.Thread(target=self._httpd.serve_forever,
+                                 daemon=True)
+            t.start()
+        return self._httpd
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

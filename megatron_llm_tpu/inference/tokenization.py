@@ -1,0 +1,70 @@
+"""Prompt tokenization / generation detokenization.
+
+Parity target: ref megatron/text_generation/tokenization.py —
+`tokenize_prompts` (:47, pad to max prompt + tokens_to_generate) and
+`detokenize_generations` (:13, with per-token segments). The reference
+broadcasts tokenized prompts from rank 0; single-controller JAX needs no
+broadcast.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def tokenize_prompts(
+    tokenizer,
+    prompts: List[str],
+    tokens_to_generate: int,
+    add_BOS: bool = False,
+    pad_to_multiple: int = 64,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """-> (tokens (b, max_len) int32 right-padded with eod, lengths (b,)).
+
+    max_len = max prompt length + tokens_to_generate, rounded up to
+    `pad_to_multiple` so the jitted decode loop compiles for a bounded set
+    of shapes (the reference pads exactly, :86-95, and recompiles nothing
+    because eager torch doesn't care).
+    """
+    if add_BOS:
+        bos = getattr(tokenizer, "bos", None)
+        assert bos is not None, "tokenizer has no BOS token"
+        prompt_ids = [[bos] + tokenizer.tokenize(p) for p in prompts]
+    else:
+        prompt_ids = [tokenizer.tokenize(p) for p in prompts]
+    lengths = np.asarray([len(p) for p in prompt_ids], np.int32)
+    max_len = int(lengths.max()) + tokens_to_generate
+    if pad_to_multiple > 1:
+        max_len = ((max_len + pad_to_multiple - 1) // pad_to_multiple
+                   ) * pad_to_multiple
+    pad_id = tokenizer.eod
+    tokens = np.full((len(prompts), max_len), pad_id, np.int32)
+    for i, ids in enumerate(prompt_ids):
+        tokens[i, : len(ids)] = ids
+    return tokens, lengths
+
+
+def detokenize_generations(
+    tokenizer,
+    tokens: np.ndarray,  # (b, s)
+    lengths: np.ndarray,  # (b,) valid lengths incl. prompt
+    return_segments: bool = False,
+):
+    """-> (texts, [segments]) (ref: detokenize_generations :13-44)."""
+    texts = []
+    segments: List[List[str]] = []
+    for row, n in zip(np.asarray(tokens), np.asarray(lengths)):
+        ids = [int(t) for t in row[: int(n)]]
+        texts.append(tokenizer.detokenize(ids))
+        if return_segments:
+            seg = []
+            for tid in ids:
+                # per-token surface form (ref uses tokenizer-specific
+                # decoder lookups, :27-39)
+                seg.append(tokenizer.detokenize([tid]))
+            segments.append(seg)
+    if return_segments:
+        return texts, segments
+    return texts

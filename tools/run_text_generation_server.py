@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Start the REST text-generation server on a checkpoint.
+
+The rebuild of ref tools/run_text_generation_server.py: load a native
+checkpoint (trained or converter-written "release"), build the tokenizer,
+serve PUT /api.
+
+    python tools/run_text_generation_server.py --load /path/ckpt \
+        --model llama --tokenizer_type SentencePieceTokenizer \
+        --vocab_file tok.model --port 5000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--load", required=True)
+    p.add_argument("--model", choices=["llama", "falcon", "gpt"],
+                   default="llama")
+    p.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
+    p.add_argument("--vocab_file", default=None)
+    p.add_argument("--merge_file", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=5000)
+    args = p.parse_args()
+
+    import jax
+    import orbax.checkpoint as ocp
+
+    from megatron_llm_tpu.config import (
+        falcon_config,
+        gpt_config,
+        llama_config,
+    )
+    from megatron_llm_tpu.inference.server import MegatronServer
+    from megatron_llm_tpu.models import FalconModel, GPTModel, LlamaModel
+    from megatron_llm_tpu.tokenizer import build_tokenizer
+    from megatron_llm_tpu.training.checkpointing import (
+        checkpoint_dir,
+        read_tracker,
+    )
+
+    iteration, release = read_tracker(args.load)
+    path = checkpoint_dir(args.load, iteration or 0, release=release)
+    with open(os.path.join(path, "meta.json")) as f:
+        saved = json.load(f)["config"]
+
+    common = {k: saved[k] for k in (
+        "num_layers", "hidden_size", "num_attention_heads",
+        "num_attention_heads_kv", "ffn_hidden_size", "seq_length",
+        "max_position_embeddings", "padded_vocab_size", "rope_theta",
+        "layernorm_epsilon",
+    ) if k in saved}
+    if args.model == "llama":
+        cfg = llama_config(7, vocab_size=saved["padded_vocab_size"], **common)
+        model = LlamaModel(cfg)
+    elif args.model == "falcon":
+        cfg = falcon_config(
+            7, vocab_size=saved["padded_vocab_size"],
+            parallel_layernorm=saved.get("parallel_layernorm", False),
+            **common,
+        )
+        model = FalconModel(cfg)
+    else:
+        cfg = gpt_config(vocab_size=saved["padded_vocab_size"], **common)
+        model = GPTModel(cfg)
+
+    tmpl = jax.eval_shape(model.init, jax.random.key(0))
+    params = ocp.StandardCheckpointer().restore(
+        os.path.join(path, "model"),
+        jax.tree.map(ocp.utils.to_shape_dtype_struct, tmpl),
+    )
+    tokenizer = build_tokenizer(
+        args.tokenizer_type, vocab_file=args.vocab_file,
+        merge_file=args.merge_file,
+    )
+    print(f"serving {args.model} from {path} on "
+          f"http://{args.host}:{args.port}/api", flush=True)
+    MegatronServer(model, params, tokenizer).run(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()
