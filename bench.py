@@ -4,13 +4,22 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Methodology: the reference's in-repo anchor is the Llama-2-7B fine-tune at
 ~890 tokens/sec/GPU on A100-80GB (BASELINE.md; docs/guide/getting_started.md
-:195-201 — seq length is inferred, see BASELINE.md caveat). A 7B model does
-not fit on the single 16GB v5e chip available here, so we train the largest
-complete Llama-architecture model that does (~0.74B) and normalise by model
-FLOPs: achieved model-FLOP/s = tokens/sec * 6 * n_params. vs_baseline is
-our achieved model-FLOP/s over the A100 baseline's (890 * 6 * 7e9).
+:195-201). A 7B model does not fit on the single 16GB v5e chip available
+here, so we train the largest complete Llama-architecture model that does
+(~0.74B) and normalise by model FLOPs: achieved model-FLOP/s =
+tokens/sec * flops_per_token. vs_baseline is our achieved model-FLOP/s over
+the A100 baseline's (890 tok/s * 6 * 7e9).
+
+Config matches how the reference actually trains (BASELINE.md row 1):
+flash attention ON (the Pallas kernel, compiled by Mosaic on this chip),
+bf16 compute; full remat is memory-forced on this 16GB chip (see inline
+note). MFU is reported against the v5e bf16 peak (197 TFLOP/s), counting
+6*N_params + causal attention FLOPs per token.
+
+Usage: python bench.py [--seq 1024|4096]
 """
 
+import argparse
 import json
 import time
 
@@ -22,9 +31,23 @@ from megatron_llm_tpu.models import LlamaModel
 from megatron_llm_tpu.optimizer import init_optimizer_state
 from megatron_llm_tpu.training import make_train_step
 
+V5E_PEAK_BF16 = 197e12  # per-chip bf16 FLOP/s
+
 
 def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=1024, choices=[1024, 4096])
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
     assert jax.default_backend() == "tpu", jax.default_backend()
+
+    seq = args.seq
+    # Full remat is memory-forced at 0.74B on the 16GB chip: without it the
+    # live activations need 23G at mbs 8 / seq 1024 (measured), and the
+    # chip tops out at mbs 2 with ~13% lower FLOP/s. Block-remat (fewer
+    # rematted layers) measured flat — the step is compute-bound, not
+    # recompute-bound.
+    mbs = 8 if seq == 1024 else 2
 
     cfg = ModelConfig(
         num_layers=12,
@@ -32,8 +55,8 @@ def main():
         num_attention_heads=16,
         num_attention_heads_kv=16,
         ffn_hidden_size=5504,
-        seq_length=1024,
-        max_position_embeddings=1024,
+        seq_length=seq,
+        max_position_embeddings=seq,
         padded_vocab_size=32000,
         position_embedding_type="rotary",
         glu_activation="swiglu",
@@ -43,18 +66,18 @@ def main():
         hidden_dropout=0.0,
         attention_dropout=0.0,
         params_dtype=jnp.float32,  # fp32 master params, bf16 compute (design contract)
+        use_flash_attn=True,
         recompute_granularity="full",
     )
     model = LlamaModel(cfg)
     params = model.init(jax.random.key(0))
     n_params = sum(p.size for p in jax.tree.leaves(params))
 
-    tcfg = TrainConfig(micro_batch_size=8, global_batch_size=8, lr=1e-4)
+    tcfg = TrainConfig(micro_batch_size=mbs, global_batch_size=mbs, lr=1e-4)
     pcfg = ParallelConfig(num_microbatches=1)
     opt_state = init_optimizer_state(params, tcfg)
     step = jax.jit(make_train_step(model, tcfg, pcfg), donate_argnums=(0, 1))
 
-    mbs, seq = tcfg.micro_batch_size, cfg.seq_length
     tokens = jax.random.randint(jax.random.key(1), (1, mbs, seq), 0, 32000)
     batch = {"tokens": tokens, "labels": jnp.roll(tokens, -1, axis=-1)}
     lr = jnp.float32(1e-4)
@@ -66,7 +89,7 @@ def main():
         params, opt_state, stats = step(params, opt_state, batch, lr, wd)
     float(stats["loss"])
 
-    n_iters = 20
+    n_iters = args.iters
     t0 = time.perf_counter()
     for _ in range(n_iters):
         params, opt_state, stats = step(params, opt_state, batch, lr, wd)
@@ -74,14 +97,22 @@ def main():
     dt = time.perf_counter() - t0
 
     tok_per_sec = mbs * seq * n_iters / dt
+    # fwd+bwd model FLOPs per token: 6*N for the matmuls + causal attention
+    # (12*L*h*s per token fwd+bwd with the 1/2 causal discount).
+    attn_flops_per_tok = 6 * cfg.num_layers * cfg.hidden_size * seq
+    flops_per_tok = 6 * n_params + attn_flops_per_tok
+    mfu = tok_per_sec * flops_per_tok / V5E_PEAK_BF16
+    # vs_baseline compares 6N-only model FLOP/s on both sides (the A100
+    # anchor's attention FLOPs aren't recoverable from BASELINE.md)
     achieved_flops = tok_per_sec * 6 * n_params
     baseline_flops = 890.0 * 6 * 7.0e9  # A100 anchor, BASELINE.md
     print(
         json.dumps(
             {
                 "metric": (
-                    "tokens/sec/chip, Llama-arch 0.74B pretrain, seq 1024, "
-                    "bf16, full remat, v5e (FLOP-normalized vs A100 7B anchor)"
+                    f"tokens/sec/chip, Llama-arch 0.74B pretrain, seq {seq}, "
+                    f"bf16, flash-attn(Pallas) ON, full remat, "
+                    f"v5e, MFU {mfu:.1%} (FLOP-normalized vs A100 7B anchor)"
                 ),
                 "value": round(tok_per_sec, 1),
                 "unit": "tokens/sec/chip",

@@ -95,6 +95,9 @@ def build_base_parser() -> argparse.ArgumentParser:
     g.add_argument("--dataloader_type", default="single",
                    choices=["single", "cyclic"])
     g.add_argument("--use_flash_attn", action="store_true", default=None)
+    # Llama presets default flash ON; this is the CLI off-switch.
+    g.add_argument("--no_use_flash_attn", dest="use_flash_attn",
+                   action="store_false")
     g.add_argument("--recompute_granularity", default=None,
                    choices=[None, "full", "selective"])
     g.add_argument("--sequence_parallel", action="store_true")
@@ -129,8 +132,12 @@ def build_base_parser() -> argparse.ArgumentParser:
     g = p.add_argument_group("distributed")  # ref :820-866
     g.add_argument("--tensor_model_parallel_size", type=int, default=1)
     g.add_argument("--pipeline_model_parallel_size", type=int, default=1)
+    # --num_layers_per_virtual_pipeline_stage (ref arguments.py:828) is
+    # deliberately unsupported: the per-tick-remat schedule makes
+    # num_microbatches the bubble lever (see ParallelConfig note); accept
+    # and reject it explicitly so reference scripts fail loudly.
     g.add_argument("--num_layers_per_virtual_pipeline_stage", type=int,
-                   default=None)
+                   default=None, help=argparse.SUPPRESS)
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--data_parallel_size", type=int, default=None)
 
@@ -165,6 +172,12 @@ def args_to_configs(args, padded_vocab_size: int):
     validate_args derivations, arguments.py:52-345)."""
     tp = args.tensor_model_parallel_size
     pp = args.pipeline_model_parallel_size
+    if getattr(args, "num_layers_per_virtual_pipeline_stage", None):
+        raise SystemExit(
+            "--num_layers_per_virtual_pipeline_stage is unsupported by "
+            "design: the per-tick-remat pipeline schedule makes "
+            "num_microbatches the bubble lever (see ParallelConfig)."
+        )
 
     overrides = {}
     for name in (
@@ -226,7 +239,6 @@ def args_to_configs(args, padded_vocab_size: int):
         data_parallel_size=dp,
         pipeline_parallel_size=pp,
         tensor_parallel_size=tp,
-        virtual_pipeline_parallel_size=args.num_layers_per_virtual_pipeline_stage,
         sequence_parallel=args.sequence_parallel,
         use_distributed_optimizer=args.use_distributed_optimizer,
         num_microbatches=num_micro,

@@ -67,7 +67,15 @@ def generate_and_post_process(
 
     rng = None
     if top_k_sampling != 1:
-        seed = random_seed if random_seed >= 0 else 0
+        # random_seed < 0 means "unseeded": the reference leaves torch's
+        # global PRNG alone so repeated requests differ (api.py:100-109);
+        # mirror that with a fresh OS-entropy seed per call.
+        if random_seed >= 0:
+            seed = random_seed
+        else:
+            import os as _os
+
+            seed = int.from_bytes(_os.urandom(4), "little")
         rng = jax.random.key(seed)
 
     # prefill the longest common multiple-of-64 prefix; the rest of each
@@ -132,6 +140,7 @@ def beam_search_and_post_process(
         num_return_gen=num_return_gen,
         length_penalty=length_penalty,
         vocab_size=tokenizer.vocab_size,
+        max_new_tokens=tokens_to_generate,
     )
     out_tokens = np.asarray(out_tokens)
     out_lengths = np.full((out_tokens.shape[0],), out_tokens.shape[1],

@@ -280,10 +280,16 @@ def beam_search(
     num_return_gen: int = 1,
     length_penalty: float = 1.0,
     vocab_size: Optional[int] = None,
+    max_new_tokens: Optional[int] = None,
 ):
     """Batch-1 beam search (the reference asserts batch==1 too,
     generation.py:295). Host loop over positions with jitted single-token
     steps; beam bookkeeping mirrors BeamHypotheses.
+
+    `max_new_tokens` bounds the decode independently of the buffer's
+    compile-shape padding, so generations never exceed the requested
+    budget (the buffer is padded up to a multiple of 64 for jit-cache
+    stability — without the bound the loop would run to the pad).
 
     Returns (tokens (num_return_gen, out_len), scores (num_return_gen,)).
     """
@@ -291,6 +297,8 @@ def beam_search(
 
     assert tokens.shape[0] == 1, "beam search: batch size must be 1"
     max_len = tokens.shape[1]
+    if max_new_tokens is not None:
+        max_len = min(max_len, prompt_length + max_new_tokens)
     tokens = jnp.broadcast_to(tokens, (beam_size,) + tokens.shape[1:]).astype(
         jnp.int32
     )

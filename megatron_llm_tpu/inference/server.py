@@ -142,7 +142,7 @@ class MegatronGenerate:
                     "logprobs": lp.tolist() if lp is not None else None,
                 }, 200
             except Exception as e:  # ref returns jsonified error (:230)
-                return json.dumps({"message": repr(e)}), 500
+                return {"message": repr(e)}, 500
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -156,7 +156,18 @@ def attention_block(
         else:
             if mask is None:
                 mask = causal_mask(s)
-            ctx = grouped_attention(q, k, v, mask, cfg, dropout_rng, deterministic)
+            core = lambda q_, k_, v_, m_: grouped_attention(  # noqa: E731
+                q_, k_, v_, m_, cfg, dropout_rng, deterministic
+            )
+            if cfg.recompute_granularity == "selective":
+                # Selective recompute = don't save the O(s*t) softmax
+                # probabilities for backward; recompute core attention from
+                # the saved q/k/v (ref: --recompute-granularity selective,
+                # transformer.py:357-401 checkpoints CoreAttention only).
+                # The flash path needs no remat: its custom VJP already
+                # recomputes scores tile-by-tile.
+                core = jax.checkpoint(core)
+            ctx = core(q, k, v, mask)
         new_cache = None
 
     ctx = shard_activation(

@@ -14,10 +14,10 @@ import jax.numpy as jnp
 
 from megatron_llm_tpu.config import ModelConfig
 from megatron_llm_tpu.models.language_model import (
+    chunked_head_cross_entropy,
     init_language_model_params,
     language_model_forward,
 )
-from megatron_llm_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
 
 
 class GPTModel:
@@ -61,11 +61,15 @@ class GPTModel:
         deterministic: bool = True,
     ) -> jnp.ndarray:
         """Mean masked CE (ref: post_language_model_processing
-        gpt_model.py:18-42 + loss_func finetune.py:83-89)."""
-        logits, _ = self.forward(
-            params, tokens, position_ids, attention_mask, dropout_rng, deterministic
+        gpt_model.py:18-42 + loss_func finetune.py:83-89).
+
+        The head + CE run chunked over the sequence so full (b, s, V)
+        logits never materialise (see chunked_head_cross_entropy)."""
+        hidden, _ = language_model_forward(
+            params, self.cfg, tokens, position_ids, attention_mask,
+            dropout_rng, deterministic, return_hidden=True,
         )
-        losses = vocab_parallel_cross_entropy(logits, labels)
+        losses = chunked_head_cross_entropy(params, self.cfg, hidden, labels)
         if loss_mask is None:
             return jnp.mean(losses)
         loss_mask = loss_mask.astype(jnp.float32)

@@ -236,18 +236,41 @@ def transformer_stack(
         )
         return (out,), new_cache_l
 
+    # How many layers get full recompute (ref: --recompute-method
+    # arguments.py:616-630): "uniform" remats every layer (each scan step
+    # checkpointed); "block" remats only the first recompute_num_layers —
+    # the rest keep their activations, soaking up whatever HBM is left.
     if cfg.recompute_granularity == "full":
-        body = jax.checkpoint(body, prevent_cse=False)
+        if cfg.recompute_method == "block":
+            n_remat = min(cfg.recompute_num_layers, L)
+        else:
+            n_remat = L
+    else:
+        n_remat = 0
+    body_ck = jax.checkpoint(body, prevent_cse=False)
 
     idxs = layer_offset + jnp.arange(L)
     if kv_caches is not None:
         xs = (layer_params, idxs, {"k": kv_caches["k"], "v": kv_caches["v"],
                                    "offset": jnp.broadcast_to(kv_caches["offset"], (L,))})
-        (hidden,), caches_out = jax.lax.scan(body, (hidden,), xs)
+        f = body_ck if n_remat == L else body
+        (hidden,), caches_out = jax.lax.scan(f, (hidden,), xs)
         new_caches = {"k": caches_out["k"], "v": caches_out["v"],
                       "offset": kv_caches["offset"] + hidden.shape[1]}
     else:
         xs = (layer_params, idxs, None)
-        (hidden,), _ = jax.lax.scan(body, (hidden,), xs)
+        if 0 < n_remat < L:
+            take = lambda tree, a, b: jax.tree.map(  # noqa: E731
+                lambda x: x[a:b], tree
+            )
+            (hidden,), _ = jax.lax.scan(
+                body_ck, (hidden,), take(xs, 0, n_remat)
+            )
+            (hidden,), _ = jax.lax.scan(
+                body, (hidden,), take(xs, n_remat, L)
+            )
+        else:
+            f = body_ck if n_remat == L else body
+            (hidden,), _ = jax.lax.scan(f, (hidden,), xs)
         new_caches = None
     return hidden, new_caches

@@ -23,10 +23,17 @@ The TPU design collapses all of that into one differentiable program:
   sharding of each stage's compute keeps working unchanged.
 
 Schedule note: AD produces a GPipe-style schedule (all-forward then
-all-backward per scan transpose) rather than interleaved 1F1B; the 1F1B
-memory win is recovered with `jax.checkpoint` on the stage body (activation
-stash per microbatch = one remat'd layer chunk). A hand-scheduled
-1F1B/interleaved variant is a planned optimization (SURVEY.md §7 step 6).
+all-backward per scan transpose) rather than interleaved 1F1B — but the
+thing 1F1B exists to bound (per-stage live activation memory,
+schedules.py:606-722) is bounded here differently and harder: every tick
+body is `jax.checkpoint`ed, so the backward keeps ONLY the (b, s, h)
+boundary carry per tick and recomputes stage internals. 1F1B keeps <=pp
+in-flight stashes of a stage's FULL internal activations (~tens of b*s*h
+per layer chunk); this design keeps (num_micro + pp - 1) single-boundary
+tensors. For any real depth/width the boundary stash is the smaller
+footprint, and raising num_micro to shrink the GPipe bubble stays cheap —
+which also removes the need for interleaved/vpp scheduling (that exists to
+shrink the bubble when 1F1B memory forbids more microbatches).
 """
 
 from __future__ import annotations
@@ -89,8 +96,25 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
     `stage`. `batch` arrays are (num_micro, b, s[, ...]).
 
     Replaces the reference's forward_backward_pipelining_* schedules
-    (schedules.py:253-722): here one jitted function does embed -> pipelined
-    stack -> head/CE, and jax.grad of it is the full pipelined backward.
+    (schedules.py:253-722): here one jitted function runs the whole
+    embed -> stack -> head/CE pipeline INSIDE a scan-over-ticks, and
+    jax.grad of it is the pipelined backward.
+
+    Memory design (the reason the reference hand-schedules 1F1B,
+    schedules.py:606-722):
+    - embedding runs in-tick, so no (num_micro, b, s, h) input buffer —
+      only the int32 token batch enters the region;
+    - the last stage computes final-norm + logits + CE in-tick under a
+      `lax.cond` and banks two SCALARS per microbatch — no
+      (num_micro, b, s, V) logits or (num_micro, b, s, h) output buffer;
+    - each tick body is `jax.checkpoint`ed: backward keeps only the
+      (b, s, h) boundary carry per tick and recomputes stage internals,
+      so peak live activations are ticks x b*s*h boundary values — far
+      below 1F1B's pp in-flight FULL-chunk stashes for real configs.
+
+    Loss averaging matches the reference: mean over microbatches of each
+    microbatch's masked-mean loss (training.py:442-448), not the global
+    token-weighted mean.
     """
     cfg = model.cfg
     mesh = ctx.mesh
@@ -104,32 +128,35 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
         num_micro, b, s = tokens.shape
         deterministic = dropout_rng is None
 
-        if cfg.position_embedding_type == "rotary":
+        has_rope = cfg.position_embedding_type == "rotary"
+        if has_rope:
             rope_table = precompute_rope(
                 cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
                 cfg.rope_scaling_factor,
             )
         else:
-            rope_table = None
+            rope_table = jnp.zeros((1,), jnp.float32)  # placeholder operand
 
-        # ---- embed all microbatches (stage-replicated GSPMD compute) ----
-        def embed_micro(toks, pids, rng):
-            return embed_tokens(params, cfg, toks, pids, rng, deterministic)
-
-        emb_rngs = None
-        if dropout_rng is not None:
-            emb_rngs = jax.random.split(
-                jax.random.fold_in(dropout_rng, 0), num_micro
+        if loss_mask is None:
+            loss_mask = jnp.ones((num_micro, b, s), jnp.float32)
+        else:
+            loss_mask = loss_mask.astype(jnp.float32)
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32)[None, None], (num_micro, b, s)
             )
-        hidden_micro = jax.vmap(embed_micro)(
-            tokens,
-            position_ids
-            if position_ids is not None
-            else jnp.broadcast_to(jnp.arange(s)[None, None], (num_micro, 1, s)),
-            emb_rngs,
-        )  # (num_micro, b, s, h)
 
-        # ---- pipelined stack over `stage` ------------------------------
+        # Everything the in-tick embed + head need, entering the region
+        # stage-replicated; the shard_map transpose psums their grads over
+        # `stage` — which IS the reference's tied embedding-grad allreduce
+        # (parallel_state.py:172-199) for free.
+        aux_params = {
+            "embedding": params["embedding"],
+            "final_norm": params["final_norm"],
+        }
+        if not cfg.tie_embed_logits:
+            aux_params["lm_head"] = params["lm_head"]
+
         # Boundary/carry dtype: values whose shard_map/pcast transposes emit
         # copy-all-reduces must not be bf16 on CPU — XLA-CPU's
         # AllReducePromotion pass crashes cloning a copy-bodied all-reduce
@@ -139,40 +166,94 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
             jnp.float32 if jax.default_backend() == "cpu" else cfg.compute_dtype
         )
 
-        def stack_shard(layers_local, hidden_mb):
-            # layers_local: (L/pp, ...); hidden_mb: (num_micro, b, s, h)
+        def stack_shard(layers_local, aux, toks, lbls, lmask, pids, rope):
+            # layers_local: (L/pp, ...); toks/lbls/pids: (num_micro, b, s)
             from megatron_llm_tpu.parallel.mesh import manual_region
 
             with manual_region():
-                out = _stack_shard_body(
-                    layers_local, hidden_mb.astype(boundary_dtype)
+                return _stack_shard_body(
+                    layers_local, aux, toks, lbls, lmask, pids, rope
                 )
-            return out.astype(jnp.float32)
 
-        def _stack_shard_body(layers_local, hidden_mb):
+        def _stack_shard_body(layers_local, aux, toks, lbls, lmask, pids,
+                              rope):
             stage = jax.lax.axis_index(STAGE_AXIS)
             total = num_micro + num_stages - 1
-            state = jnp.zeros_like(hidden_mb[0])
+
+            # Mark every replicated operand stage-varying up front, while
+            # still fp32/int32. If a replicated fp32 param is first cast to
+            # bf16 and only then implicitly pvary'd (by meeting a varying
+            # value), the pvary is a bf16 copy-bodied all-reduce and
+            # XLA-CPU's AllReducePromotion pass aborts cloning it ("Invalid
+            # binary instruction opcode copy"); pcast-then-cast sidesteps
+            # it and is a free no-op marker on TPU.
+            pv = lambda x: jax.lax.pcast(x, (STAGE_AXIS,), to="varying")  # noqa: E731
+            aux = jax.tree.map(pv, aux)
+            toks, lbls, lmask, pids, rope = map(pv, (toks, lbls, lmask,
+                                                     pids, rope))
+            rope_t = rope if has_rope else None
+
+            def head_losses(hidden, lbl_t, lm_t):
+                h = apply_norm(
+                    hidden.astype(cfg.compute_dtype), aux["final_norm"], cfg
+                )
+                logits = lm_logits(aux, cfg, h)
+                losses = cross_entropy(logits, lbl_t)
+                return jnp.sum(losses * lm_t), jnp.sum(lm_t)
 
             def tick(carry, t):
-                state, outputs = carry
-                feed = jax.lax.dynamic_index_in_dim(
-                    hidden_mb, jnp.clip(t, 0, num_micro - 1), axis=0,
-                    keepdims=False,
-                )
-                inp = jnp.where(stage == 0, feed, state).astype(cfg.compute_dtype)
-                rng_t = None
+                state, sums, denoms = carry
+                m_in = jnp.clip(t, 0, num_micro - 1)
+                toks_t = jax.lax.dynamic_index_in_dim(toks, m_in, 0, False)
+                pids_t = jax.lax.dynamic_index_in_dim(pids, m_in, 0, False)
+                rng_e = rng_t = None
                 if dropout_rng is not None:
-                    rng_t = jax.random.fold_in(dropout_rng, 1 + t * num_stages)
-                out = _stage_body(cfg, layers_local, inp, rope_table, None,
-                                  None, rng_t, deterministic, stage, num_stages)
+                    rng_e = jax.random.fold_in(dropout_rng, m_in)
+                    rng_t = jax.random.fold_in(
+                        dropout_rng, num_micro + 1 + t * num_stages
+                    )
+                # in-tick embed: every stage computes the (cheap) gather,
+                # only stage 0 consumes it — no (num_micro,b,s,h) buffer
+                emb = embed_tokens(aux, cfg, toks_t, pids_t, rng_e,
+                                   deterministic).astype(boundary_dtype)
+                inp = jnp.where(stage == 0, emb, state).astype(
+                    cfg.compute_dtype
+                )
+                out = _stage_body(cfg, layers_local, inp, rope_t, None,
+                                  None, rng_t, deterministic, stage,
+                                  num_stages)
                 out = out.astype(boundary_dtype)
-                # last stage banks microbatch t-(pp-1) when in range
-                slot = jnp.clip(t - (num_stages - 1), 0, num_micro - 1)
+
+                # last stage runs head + CE for the microbatch leaving the
+                # pipe this tick; other stages skip the head FLOPs entirely
+                m_out = jnp.clip(t - (num_stages - 1), 0, num_micro - 1)
                 valid = (stage == num_stages - 1) & (t >= num_stages - 1)
-                banked = jax.lax.dynamic_index_in_dim(outputs, slot, 0, False)
-                outputs = jax.lax.dynamic_update_index_in_dim(
-                    outputs, jnp.where(valid, out, banked), slot, 0
+                lbl_t = jax.lax.dynamic_index_in_dim(lbls, m_out, 0, False)
+                lm_t = jax.lax.dynamic_index_in_dim(lmask, m_out, 0, False)
+                zero = jax.lax.pcast(
+                    jnp.float32(0.0), (STAGE_AXIS,), to="varying"
+                )
+                sum_t, den_t = jax.lax.cond(
+                    valid,
+                    lambda h: head_losses(h, lbl_t, lm_t),
+                    lambda h: (zero, zero),
+                    out,
+                )
+                sums = jax.lax.dynamic_update_index_in_dim(
+                    sums,
+                    jnp.where(
+                        valid, sum_t,
+                        jax.lax.dynamic_index_in_dim(sums, m_out, 0, False),
+                    ),
+                    m_out, 0,
+                )
+                denoms = jax.lax.dynamic_update_index_in_dim(
+                    denoms,
+                    jnp.where(
+                        valid, den_t,
+                        jax.lax.dynamic_index_in_dim(denoms, m_out, 0, False),
+                    ),
+                    m_out, 0,
                 )
                 # rotate stage s -> s+1 (ref: send_forward
                 # p2p_communication.py:292; backward of this ppermute is the
@@ -181,52 +262,52 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                     out, STAGE_AXIS,
                     [(i, i + 1) for i in range(num_stages - 1)],
                 )
-                return (state, outputs), None
+                return (state, sums, denoms), None
+
+            # backward keeps only the tick-boundary carries; stage internals
+            # are recomputed (the TPU answer to deallocate_output_tensor +
+            # 1F1B's bounded stash, schedules.py:36-88)
+            tick = jax.checkpoint(tick, prevent_cse=False)
 
             # carries become stage-varying inside the loop; mark the zero
             # initials as varying so the scan carry types are stable
-            state = jax.lax.pcast(state, (STAGE_AXIS,), to="varying")
-            outputs0 = jax.lax.pcast(
-                jnp.zeros_like(hidden_mb), (STAGE_AXIS,), to="varying"
+            state = jax.lax.pcast(
+                jnp.zeros((b, s, cfg.hidden_size), boundary_dtype),
+                (STAGE_AXIS,), to="varying",
             )
-            (_, outputs), _ = jax.lax.scan(
-                tick, (state, outputs0), jnp.arange(total)
+            sums0 = jax.lax.pcast(
+                jnp.zeros((num_micro,), jnp.float32), (STAGE_AXIS,),
+                to="varying",
             )
-            # stack over a leading stage axis: each stage contributes its
-            # banked buffer (only the last stage's is meaningful); the
-            # caller slices [-1], which XLA lowers to one transfer from the
-            # last stage (the analogue of the last->first stage broadcast,
+            denoms0 = jax.lax.pcast(
+                jnp.zeros((num_micro,), jnp.float32), (STAGE_AXIS,),
+                to="varying",
+            )
+            (_, sums, denoms), _ = jax.lax.scan(
+                tick, (state, sums0, denoms0), jnp.arange(total)
+            )
+            # leading stage axis: only the last stage's row is meaningful;
+            # the caller slices [-1], one scalar-row transfer from the last
+            # stage (the analogue of the last->first stage loss broadcast,
             # ref: text_generation/communication.py:111).
-            return outputs[None]
+            return sums[None], denoms[None]
 
         stack_mapped = jax.shard_map(
             stack_shard,
             mesh=mesh,
-            in_specs=(P(STAGE_AXIS), P()),
-            out_specs=P(STAGE_AXIS),
+            in_specs=(P(STAGE_AXIS), P(), P(), P(), P(), P(), P()),
+            out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)),
             axis_names={STAGE_AXIS},
         )
-        hidden_out = stack_mapped(
-            params["layers"], hidden_micro.astype(jnp.float32)
-        )[-1].astype(cfg.compute_dtype)
-
-        # ---- head + loss (stage-replicated) -----------------------------
-        def head_micro(hidden, lbls, lmask):
-            h = apply_norm(hidden, params["final_norm"], cfg)
-            logits = lm_logits(params, cfg, h)
-            losses = cross_entropy(logits, lbls)
-            if lmask is None:
-                return jnp.sum(losses), jnp.float32(losses.size)
-            lmask = lmask.astype(jnp.float32)
-            return jnp.sum(losses * lmask), jnp.sum(lmask)
-
-        if loss_mask is None:
-            sums, denoms = jax.vmap(lambda h, l: head_micro(h, l, None))(
-                hidden_out, labels
-            )
-        else:
-            sums, denoms = jax.vmap(head_micro)(hidden_out, labels, loss_mask)
-        return jnp.sum(sums) / jnp.maximum(jnp.sum(denoms), 1.0)
+        sums, denoms = stack_mapped(
+            params["layers"], aux_params, tokens.astype(jnp.int32),
+            labels.astype(jnp.int32), loss_mask,
+            position_ids.astype(jnp.int32), rope_table,
+        )
+        sums, denoms = sums[-1], denoms[-1]  # (num_micro,)
+        # reference averaging: mean of per-microbatch masked means
+        # (training.py:442-448)
+        return jnp.mean(sums / jnp.maximum(denoms, 1.0))
 
     return loss_fn
 

@@ -47,6 +47,15 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # Correctness gates compare against torch's true-fp32 matmuls. JAX's
+    # default matmul precision lowers fp32 matmul inputs (bf16-class passes;
+    # ~1e-3 relative error per matmul on both CPU and TPU), which compounds
+    # with depth — a 4-layer/h128 model drifts to ~6e-3 max-abs logit error.
+    # Pin the highest precision so an fp32 run is actually fp32; this is the
+    # analogue of the reference running its gate in full torch fp32
+    # (ref: tests/test_llama_weights.py:104-106).
+    jax.config.update("jax_default_matmul_precision", "highest")
+
     from megatron_llm_tpu.convert import hf_falcon_to_native, hf_llama_to_native
     from megatron_llm_tpu.models import FalconModel, LlamaModel
     from tools.convert_weights import _model_cfg_from_hf
