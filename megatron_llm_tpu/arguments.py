@@ -163,6 +163,8 @@ def build_base_parser() -> argparse.ArgumentParser:
     g.add_argument("--log_interval", type=int, default=100)
     g.add_argument("--tensorboard_dir", type=str, default=None)
     g.add_argument("--wandb_logger", action="store_true")
+    g.add_argument("--log_params_norm", action="store_true")
+    g.add_argument("--log_num_zeros_in_grad", action="store_true")
 
     return p
 
@@ -288,6 +290,8 @@ def args_to_configs(args, padded_vocab_size: int):
         eval_iters=args.eval_iters,
         tensorboard_dir=args.tensorboard_dir,
         wandb_logger=args.wandb_logger,
+        log_params_norm=args.log_params_norm,
+        log_num_zeros_in_grad=args.log_num_zeros_in_grad,
         seed=args.seed,
     )
 

@@ -69,8 +69,12 @@ class ModelConfig:
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     fp32_residual_connection: bool = False
-    apply_query_key_layer_scaling: bool = False
-    attention_softmax_in_fp32: bool = True
+    # NOTE deliberately absent: apply_query_key_layer_scaling and
+    # attention_softmax_in_fp32 (ref arguments.py:632-650). Both exist to
+    # keep fp16 softmax in range; this build ALWAYS computes attention
+    # scores/softmax in fp32 (models/attention.py, ops/flash_attention.py),
+    # which is the apply_query_key_layer_scaling=False +
+    # attention_softmax_in_fp32=True behavior, so the knobs would be lies.
 
     # Init (ref: arguments.py:694-705, layers.py:79-125)
     init_method_std: float = 0.02
@@ -247,6 +251,9 @@ class TrainConfig:
     eval_iters: int = 100
     tensorboard_dir: Optional[str] = None
     wandb_logger: bool = False
+    # ref: --log-params-norm / --log-num-zeros-in-grad (arguments.py:481-487)
+    log_params_norm: bool = False
+    log_num_zeros_in_grad: bool = False
 
     seed: int = 1234
 

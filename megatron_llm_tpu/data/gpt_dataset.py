@@ -190,9 +190,19 @@ def _build_index_mappings(
             )
             if not build_cache:
                 return doc_idx, sample_idx, shuffle_idx
-            np.save(doc_idx_filename, doc_idx, allow_pickle=True)
-            np.save(sample_idx_filename, sample_idx, allow_pickle=True)
-            np.save(shuffle_idx_filename, shuffle_idx, allow_pickle=True)
+            # write-temp + atomic rename: non-lead processes poll bare
+            # os.path.isfile, so a half-written .npy must never be visible
+            # (the reference leans on its torch barrier instead,
+            # gpt_dataset.py:378-386)
+            for fname, arr in (
+                (doc_idx_filename, doc_idx),
+                (sample_idx_filename, sample_idx),
+                (shuffle_idx_filename, shuffle_idx),
+            ):
+                tmp = f"{fname}.tmp{os.getpid()}.npy"
+                with open(tmp, "wb") as f:
+                    np.save(f, arr, allow_pickle=True)
+                os.replace(tmp, fname)
         else:
             # non-lead processes wait for the cache (ref pseudo-barrier :378-386)
             deadline = time.time() + 600

@@ -33,8 +33,17 @@ def layer_norm(
 
 
 def apply_norm(x: jnp.ndarray, norm_params: dict, cfg) -> jnp.ndarray:
-    """Dispatch on config (ref: transformer.py chooses RMSNorm vs LayerNorm)."""
+    """Dispatch on config (ref: transformer.py chooses RMSNorm vs LayerNorm).
+
+    use_fused_rmsnorm routes through the Pallas kernel (ops/rmsnorm.py) —
+    the analogue of the reference routing norms through apex's fused CUDA
+    kernels (fused_layer_norm.py:64)."""
     if cfg.use_rms_norm:
+        if getattr(cfg, "use_fused_rmsnorm", False):
+            from megatron_llm_tpu.ops.rmsnorm import fused_rms_norm
+
+            return fused_rms_norm(x, norm_params["scale"],
+                                  cfg.layernorm_epsilon)
         return rms_norm(x, norm_params["scale"], cfg.layernorm_epsilon)
     return layer_norm(
         x, norm_params["scale"], norm_params["bias"], cfg.layernorm_epsilon

@@ -1,0 +1,161 @@
+"""Fused RMSNorm — Pallas kernel, fwd + bwd.
+
+Parity target: ref megatron/model/fused_layer_norm.py:64-139 — the
+reference routes RMSNorm/LayerNorm through apex's fused CUDA kernels; on
+TPU the fused path is this Pallas kernel. One pass over HBM per direction:
+the forward reads x once, computes the fp32 row statistic in VMEM and
+writes the normalized/scaled output plus the per-row rstd; the backward
+recomputes x_hat from the saved rstd and emits dx and a per-row-block
+partial of dscale (summed by XLA outside).
+
+Math matches models/norms.rms_norm exactly, including the cast order
+(normalize in fp32, cast to the input dtype, THEN apply the scale —
+ref: fused_layer_norm.py:133-138).
+
+`fused_rms_norm` dispatches to Pallas on TPU (hidden size lane-aligned)
+and to the XLA implementation elsewhere; `interpret=True` runs the real
+kernel through the Pallas interpreter (CPU test suite).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_ROWS = 256
+# fp32 row block + fp32 out block + scratch must sit in ~16MB VMEM
+_VMEM_BUDGET = 4 * 1024 * 1024  # floats per block, conservative
+
+
+def _choose_rows(n_rows: int, h: int) -> int | None:
+    b = DEFAULT_BLOCK_ROWS
+    while b >= 8 and (n_rows % b or b * h > _VMEM_BUDGET):
+        b //= 2
+    return b if b >= 8 and n_rows % b == 0 else None
+
+
+def _fwd_kernel(x_ref, s_ref, o_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)  # (rows, h)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    normed = (x * rstd).astype(o_ref.dtype)
+    o_ref[:] = normed * s_ref[:].astype(o_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _bwd_kernel(x_ref, s_ref, rstd_ref, g_ref, dx_ref, ds_ref, *, h):
+    i = pl.program_id(0)
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    s = s_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]  # (rows, 1) fp32
+    x_hat = x * rstd
+    u = g * s[None, :]
+    # dx = rstd * (u - x_hat * mean(u * x_hat)) over the hidden axis
+    corr = jnp.mean(u * x_hat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (u - x_hat * corr)).astype(dx_ref.dtype)
+    # dscale accumulator: the TPU grid is sequential and ds maps to the
+    # same (8, h) block every step, so it stays resident in VMEM; each
+    # step adds colsum/8 to all 8 sublanes (Mosaic requires >=8-row
+    # blocks; /8 is exact in fp32), caller sums the rows back.
+    colsum = jnp.sum(g * x_hat.astype(g_ref.dtype).astype(jnp.float32),
+                     axis=0, keepdims=True)
+
+    @pl.when(i == 0)
+    def _init():
+        ds_ref[:] = jnp.zeros_like(ds_ref)
+
+    ds_ref[:] += jnp.broadcast_to(colsum / 8.0, ds_ref.shape)
+
+
+def _pallas_fwd(x2, scale, eps, block_rows, interpret):
+    n, h = x2.shape
+    grid = (n // block_rows,)
+    out, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), x2.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, scale)
+    return out, rstd
+
+
+def _pallas_bwd(x2, scale, rstd, g2, block_rows, interpret):
+    n, h = x2.shape
+    grid = (n // block_rows,)
+    dx, ds_part = pl.pallas_call(
+        functools.partial(_bwd_kernel, h=h),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+            pl.BlockSpec((8, h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), x2.dtype),
+            jax.ShapeDtypeStruct((8, h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, scale, rstd, g2)
+    return dx, ds_part.sum(0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _fused(x2, scale, eps, block_rows, interpret):
+    out, _ = _pallas_fwd(x2, scale, eps, block_rows, interpret)
+    return out
+
+
+def _fused_fwd(x2, scale, eps, block_rows, interpret):
+    out, rstd = _pallas_fwd(x2, scale, eps, block_rows, interpret)
+    return out, (x2, scale, rstd)
+
+
+def _fused_bwd(eps, block_rows, interpret, res, g):
+    x2, scale, rstd = res
+    dx, ds = _pallas_bwd(x2, scale, rstd, g, block_rows, interpret)
+    return dx, ds.astype(scale.dtype)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6,
+                   use_pallas: bool | None = None,
+                   interpret: bool = False) -> jnp.ndarray:
+    """RMSNorm over the last axis; differentiable. Any leading shape."""
+    from megatron_llm_tpu.models.norms import rms_norm
+
+    h = x.shape[-1]
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas and h % 128 == 0:
+        lead = x.shape[:-1]
+        n = 1
+        for d in lead:
+            n *= d
+        block_rows = _choose_rows(n, h)
+        if block_rows is not None:
+            out = _fused((x.reshape(n, h)), scale, eps, block_rows,
+                         interpret)
+            return out.reshape(*lead, h)
+    return rms_norm(x, scale, eps)

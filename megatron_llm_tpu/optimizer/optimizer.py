@@ -33,6 +33,9 @@ class OptimizerState(NamedTuple):
     step: jnp.ndarray  # int32 scalar
     m: Any  # first moment (adam) or momentum buffer (sgd); params-shaped
     v: Optional[Any]  # second moment (adam) or None (sgd)
+    # fp16 loss-scaler state ({} / scale+trackers dict); None when not fp16
+    # (ref: Float16OptimizerWithFloat16Params.grad_scaler optimizer.py:270)
+    scaler: Optional[dict] = None
 
 
 def _tree_cast(tree, dtype):
@@ -56,16 +59,41 @@ def count_zeros(grads) -> jnp.ndarray:
     return sum(jnp.sum(g == 0.0) for g in leaves)
 
 
+def get_grad_scaler(tcfg: TrainConfig):
+    """Scaler for fp16 runs, None otherwise (ref: get_megatron_optimizer
+    optimizer/__init__.py:68-92: constant when --loss_scale is set, else
+    dynamic)."""
+    if not tcfg.fp16:
+        return None
+    from megatron_llm_tpu.optimizer.grad_scaler import (
+        ConstantGradScaler,
+        DynamicGradScaler,
+    )
+
+    if tcfg.loss_scale is not None:
+        return ConstantGradScaler(tcfg.loss_scale)
+    return DynamicGradScaler(
+        initial_scale=tcfg.initial_loss_scale,
+        min_scale=tcfg.min_loss_scale,
+        growth_interval=tcfg.loss_scale_window,
+        hysteresis=tcfg.hysteresis,
+    )
+
+
 def init_optimizer_state(params, tcfg: TrainConfig) -> OptimizerState:
     zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    scaler = get_grad_scaler(tcfg)
+    scaler_state = scaler.init_state() if scaler is not None else None
     if tcfg.optimizer == "adam":
         return OptimizerState(
             step=jnp.zeros((), jnp.int32),
             m=zeros,
             v=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            scaler=scaler_state,
         )
     elif tcfg.optimizer == "sgd":
-        return OptimizerState(step=jnp.zeros((), jnp.int32), m=zeros, v=None)
+        return OptimizerState(step=jnp.zeros((), jnp.int32), m=zeros, v=None,
+                              scaler=scaler_state)
     raise ValueError(f"unknown optimizer {tcfg.optimizer}")
 
 
@@ -77,11 +105,20 @@ def optimizer_step(
     lr: jnp.ndarray,
     weight_decay: Optional[jnp.ndarray] = None,
     found_inf: Optional[jnp.ndarray] = None,
+    scaler=None,
 ) -> Tuple[Any, OptimizerState, dict]:
     """One update. Mirrors MixedPrecisionOptimizer.step
     (ref: optimizer.py:407-466): unscaled fp32 grads in, global inf/nan
     check, clip by global norm, adamw/sgd update, skipped iteration leaves
     params+state untouched (ref: optimizer.py:418-432).
+
+    When `scaler` is passed (fp16), the grads must arrive ALREADY
+    unscaled; the overflow check reuses this function's grad norm (an
+    overflowed scaled grad is still inf/nan after unscaling, so one norm
+    pass serves both the skip and the scaler update — the reference's
+    separate _unscale_main_grads_and_check_for_nan pass, optimizer.py:
+    340-365, is folded in here). The returned state carries the updated
+    scale; stats gains "loss_scale".
     """
     wd = tcfg.weight_decay if weight_decay is None else weight_decay
     grads = _tree_cast(grads, jnp.float32)
@@ -90,6 +127,10 @@ def optimizer_step(
     finite = jnp.isfinite(grad_norm)
     if found_inf is not None:
         finite = finite & ~found_inf
+
+    new_scaler_state = state.scaler
+    if scaler is not None:
+        new_scaler_state = scaler.update(state.scaler, ~finite)
 
     # clip (ref: clip_grads.py:83-107)
     if tcfg.clip_grad > 0.0:
@@ -109,18 +150,23 @@ def optimizer_step(
         )
 
         def upd(p, m, v):
-            # adamw: decoupled weight decay (apex FusedAdam adam_w_mode)
+            # adamw: decoupled weight decay (apex FusedAdam adam_w_mode);
+            # 1D params (norm scales, biases) are never decayed
+            # (ref: get_param_groups optimizer/__init__.py:28-53)
             u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
             p32 = p.astype(jnp.float32)
-            return (p32 - lr * (u + wd * p32)).astype(p.dtype)
+            wd_p = wd if p.ndim >= 2 else 0.0
+            return (p32 - lr * (u + wd_p * p32)).astype(p.dtype)
 
         new_params = jax.tree.map(upd, params, new_m, new_v)
-        new_state = OptimizerState(step=step, m=new_m, v=new_v)
+        new_state = OptimizerState(step=step, m=new_m, v=new_v,
+                                   scaler=state.scaler)
     else:  # sgd with momentum
         mom = tcfg.sgd_momentum
 
         def upd_buf(b, g, p):
-            return mom * b + g + wd * p.astype(jnp.float32)
+            wd_p = wd if p.ndim >= 2 else 0.0
+            return mom * b + g + wd_p * p.astype(jnp.float32)
 
         new_m = jax.tree.map(upd_buf, state.m, grads, params)
         new_params = jax.tree.map(
@@ -128,7 +174,8 @@ def optimizer_step(
             params,
             new_m,
         )
-        new_state = OptimizerState(step=step, m=new_m, v=state.v)
+        new_state = OptimizerState(step=step, m=new_m, v=state.v,
+                                   scaler=state.scaler)
 
     # skipped iteration on inf/nan (ref: optimizer.py:418-432)
     select = lambda new, old: jax.tree.map(
@@ -139,12 +186,21 @@ def optimizer_step(
         step=jnp.where(finite, step, state.step),
         m=select(new_state.m, state.m),
         v=select(new_state.v, state.v) if state.v is not None else None,
+        scaler=new_scaler_state,
     )
 
     stats = {
         "grad_norm": grad_norm,
         "skipped": (~finite).astype(jnp.int32),
     }
+    if scaler is not None:
+        stats["loss_scale"] = scaler.scale(state.scaler)
+    # ref training_log field set (training.py:452-626): zeros-in-grad and
+    # params L2 norm, computed in-step so they ride the same dispatch
+    if tcfg.log_num_zeros_in_grad:
+        stats["num_zeros"] = count_zeros(grads)
+    if tcfg.log_params_norm:
+        stats["params_norm"] = global_grad_norm(new_params)
     return new_params, new_state, stats
 
 

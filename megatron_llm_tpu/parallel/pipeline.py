@@ -314,15 +314,38 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
 
 def make_pipelined_train_step(model, tcfg, pcfg, ctx: ParallelContext):
     """train_step(params, opt_state, batch, lr, wd, rng) for pp > 1
-    (ref: train_step + get_forward_backward_func, training.py:391-431)."""
-    from megatron_llm_tpu.optimizer.optimizer import optimizer_step
+    (ref: train_step + get_forward_backward_func, training.py:391-431).
+    fp16 loss scaling follows the same protocol as the non-pipelined step
+    (see training/train_step.py)."""
+    from megatron_llm_tpu.optimizer.optimizer import (
+        get_grad_scaler,
+        optimizer_step,
+    )
 
     loss_fn = make_pipelined_loss_fn(model, pcfg, ctx)
+    scaler = get_grad_scaler(tcfg)
 
     def train_step(params, opt_state, batch, lr, wd, rng=None):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        loss_scale = (
+            scaler.scale(opt_state.scaler) if scaler is not None else None
+        )
+
+        def scaled_loss(p, b, r):
+            loss = loss_fn(p, b, r)
+            if loss_scale is not None:
+                return loss * loss_scale, loss
+            return loss, loss
+
+        (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(
+            params, batch, rng
+        )
+        if scaler is not None:
+            # unscale; the overflow check rides optimizer_step's grad norm
+            inv = 1.0 / loss_scale
+            grads = jax.tree.map(lambda g: g * inv, grads)
         params, opt_state, stats = optimizer_step(
-            params, grads, opt_state, tcfg, lr, weight_decay=wd
+            params, grads, opt_state, tcfg, lr, weight_decay=wd,
+            scaler=scaler,
         )
         stats["loss"] = loss
         return params, opt_state, stats

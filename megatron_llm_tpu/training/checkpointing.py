@@ -97,7 +97,9 @@ def save_checkpoint(
         ckptr.save(
             os.path.join(path, "optim"),
             {"step": opt_state.step, "m": opt_state.m,
-             **({"v": opt_state.v} if opt_state.v is not None else {})},
+             **({"v": opt_state.v} if opt_state.v is not None else {}),
+             **({"scaler": opt_state.scaler}
+                if getattr(opt_state, "scaler", None) else {})},
             force=True,
         )
     meta = {
@@ -163,10 +165,13 @@ def load_checkpoint(
         tmpl = {"step": opt_state_template.step, "m": opt_state_template.m}
         if opt_state_template.v is not None:
             tmpl["v"] = opt_state_template.v
+        if getattr(opt_state_template, "scaler", None):
+            tmpl["scaler"] = opt_state_template.scaler
         abstract_opt = jax.tree.map(ocp.utils.to_shape_dtype_struct, tmpl)
         restored = ckptr.restore(os.path.join(path, "optim"), abstract_opt)
         opt_state = OptimizerState(
-            step=restored["step"], m=restored["m"], v=restored.get("v")
+            step=restored["step"], m=restored["m"], v=restored.get("v"),
+            scaler=restored.get("scaler"),
         )
 
     # --finetune resets iteration and skips optim/rng (ref :583-625)

@@ -35,11 +35,19 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
     tokens / labels / loss_mask (loss_mask optional). When
     num_microbatches == 1 a leading axis of 1 is still expected — keeps one
     trace for both cases.
-    """
-    num_micro = pcfg.num_microbatches
 
-    def loss_on_micro(params, micro, rng):
-        return model.loss(
+    fp16 runs scale the loss before backward, unscale the accumulated
+    grads, skip the step on overflow, and update the dynamic scale — the
+    whole Float16OptimizerWithFloat16Params protocol
+    (ref: optimizer/optimizer.py:270-466) inside the one jitted step.
+    """
+    from megatron_llm_tpu.optimizer.optimizer import get_grad_scaler
+
+    num_micro = pcfg.num_microbatches
+    scaler = get_grad_scaler(tcfg)
+
+    def loss_on_micro(params, micro, rng, loss_scale):
+        loss = model.loss(
             params,
             micro["tokens"],
             micro["labels"],
@@ -49,13 +57,20 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
             dropout_rng=rng,
             deterministic=rng is None,
         )
+        if loss_scale is not None:
+            # ref: MegatronOptimizer.scale_loss optimizer.py:116-120
+            return loss * loss_scale, loss
+        return loss, loss
 
     def train_step(params, opt_state: OptimizerState, batch, lr, wd, rng=None):
-        grad_fn = jax.value_and_grad(loss_on_micro)
+        loss_scale = (
+            scaler.scale(opt_state.scaler) if scaler is not None else None
+        )
+        grad_fn = jax.value_and_grad(loss_on_micro, has_aux=True)
 
         if num_micro == 1:
             micro = jax.tree.map(lambda x: x[0], batch)
-            loss, grads = grad_fn(params, micro, rng)
+            (_, loss), grads = grad_fn(params, micro, rng, loss_scale)
         else:
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params
@@ -65,7 +80,7 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
                 acc_g, acc_l = carry
                 micro, idx = xs
                 mrng = jax.random.fold_in(rng, idx) if rng is not None else None
-                l, g = grad_fn(params, micro, mrng)
+                (_, l), g = grad_fn(params, micro, mrng, loss_scale)
                 acc_g = jax.tree.map(
                     lambda a, b: a + b.astype(jnp.float32), acc_g, g
                 )
@@ -79,8 +94,14 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
             grads = jax.tree.map(lambda g: g / num_micro, grads)
             loss = loss / num_micro
 
+        if scaler is not None:
+            # unscale; the overflow check rides optimizer_step's grad norm
+            inv = 1.0 / loss_scale
+            grads = jax.tree.map(lambda g: g * inv, grads)
+
         new_params, new_state, stats = optimizer_step(
-            params, grads, opt_state, tcfg, lr, weight_decay=wd
+            params, grads, opt_state, tcfg, lr, weight_decay=wd,
+            scaler=scaler,
         )
         stats["loss"] = loss
         return new_params, new_state, stats

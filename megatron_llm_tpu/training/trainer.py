@@ -192,10 +192,17 @@ class Trainer:
             )
             osh = jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs,
                                is_leaf=lambda x: isinstance(x, P))
+            from megatron_llm_tpu.optimizer.optimizer import get_grad_scaler
+
+            sc = get_grad_scaler(self.tcfg)
+            sc_sh = (jax.tree.map(lambda _: NamedSharding(mesh, P()),
+                                  sc.init_state())
+                     if sc is not None else None)
             opt_state = jax.jit(
                 lambda p: init_optimizer_state(p, self.tcfg),
                 out_shardings=OptimizerState(
-                    step=NamedSharding(mesh, P()), m=osh, v=osh),
+                    step=NamedSharding(mesh, P()), m=osh, v=osh,
+                    scaler=sc_sh),
             )(params)
         else:
             params = self.model.init(rng)
@@ -305,10 +312,22 @@ class Trainer:
             f"elapsed time per iteration (ms): {elapsed*1000:.1f} | "
             f"learning rate: {stats['lr']:.3E} | "
             f"global batch size: {stats['batch_size']:5d} | "
-            f"lm loss: {loss:.6E} | grad norm: {gnorm:.3f} | "
-            f"skipped iterations: {int(stats['skipped'])}"
+            f"lm loss: {loss:.6E} | "
         )
+        if "loss_scale" in stats:
+            line += f"loss scale: {float(stats['loss_scale']):.1f} | "
+        line += f"grad norm: {gnorm:.3f} | "
+        if "num_zeros" in stats:
+            line += f"num zeros: {int(stats['num_zeros'])} | "
+        if "params_norm" in stats:
+            line += f"params norm: {float(stats['params_norm']):.3f} | "
+        line += f"skipped iterations: {int(stats['skipped'])}"
         print(line, flush=True)
+        # timer dump at the log cadence; only per-iteration timers get the
+        # log_interval normalizer (one-shot timers like setup/save would be
+        # misreported) — ref: timers.log call training.py:618
+        self.timers.log(["batch-generator", "train-step"],
+                        normalizer=self.tcfg.log_interval)
         if self._tb_writer is not None:
             w = self._tb_writer
             it = state.iteration
@@ -316,6 +335,12 @@ class Trainer:
             w.add_scalar("learning-rate", stats["lr"], it)
             w.add_scalar("grad-norm", gnorm, it)
             w.add_scalar("batch-size", stats["batch_size"], it)
+            if "loss_scale" in stats:
+                w.add_scalar("loss-scale", float(stats["loss_scale"]), it)
+            if "params_norm" in stats:
+                w.add_scalar("params-norm", float(stats["params_norm"]), it)
+            if "num_zeros" in stats:
+                w.add_scalar("num-zeros", int(stats["num_zeros"]), it)
             if hasattr(w, "flush"):
                 # ref: flush_all batching (training.py:706-708)
                 w.flush()
@@ -344,17 +369,25 @@ class Trainer:
 
         last_log_time = time.time()
         while tcfg.train_iters is None or state.iteration < tcfg.train_iters:
+            self.timers("batch-generator").start()
             try:
                 text = next(data_iter)
             except StopIteration:
                 print("data iterator exhausted", flush=True)
                 break
+            finally:
+                self.timers("batch-generator").stop()
             step_rng = None
             if dropout_rng is not None:
                 step_rng = jax.random.fold_in(dropout_rng, state.iteration)
             t0 = time.time()
+            # the whole fused fwd+bwd+optimizer dispatch — the reference's
+            # forward-backward/optimizer timer pair collapses into one
+            # jitted call here (training.py:431-448)
+            self.timers("train-step").start()
             stats = self.train_step(state, text, step_rng)
             loss_val = float(stats["loss"])  # host sync (axon: the real barrier)
+            self.timers("train-step").stop()
             stats["loss"] = loss_val
             elapsed = time.time() - t0
 

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Downstream-task entry point (ref: /root/reference/tasks/main.py).
+
+  python tasks/main.py --task WIKITEXT103 --model_name llama2 \\
+      --valid_data wiki.test.tokens --tokenizer_type SentencePieceTokenizer \\
+      --tokenizer_model tokenizer.model --load <checkpoint_dir>
+
+  python tasks/main.py --task LAMBADA --valid_data lambada.jsonl ...
+
+Without --load the model evaluates at random init (useful for smoke runs
+only). The retriever/Race/MNLI finetune family of the reference is not
+implemented (matching its own 'not supported' carve-outs for non-GPT
+models, main.py:80-100).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir)))
+
+import jax
+
+
+def get_tasks_args(parser):
+    """ref: get_tasks_args (tasks/main.py:14-72), minus the retriever/faiss
+    group that belongs to the unimplemented ICT stack."""
+    g = parser.add_argument_group("tasks")
+    g.add_argument("--task", type=str, required=True,
+                   choices=["WIKITEXT103", "LAMBADA"])
+    g.add_argument("--valid_data", nargs="*", default=None)
+    g.add_argument("--overlapping_eval", type=int, default=32)
+    g.add_argument("--strict_lambada", action="store_true")
+    g.add_argument("--eval_micro_batch_size", type=int, default=None)
+    return parser
+
+
+def main(argv=None):
+    from megatron_llm_tpu.arguments import args_to_configs, build_base_parser
+    from megatron_llm_tpu.parallel import initialize_parallel
+    from megatron_llm_tpu.tokenizer import build_tokenizer
+    from megatron_llm_tpu.training.checkpointing import load_checkpoint
+
+    from finetune import model_provider
+    from tasks.zeroshot.datasets import build_dataset
+    from tasks.zeroshot.evaluate import evaluate_and_print_results
+
+    parser = get_tasks_args(build_base_parser())
+    args = parser.parse_args(argv)
+    assert args.valid_data and len(args.valid_data) == 1, \
+        "--valid_data takes exactly one path"
+
+    tokenizer = build_tokenizer(
+        args.tokenizer_type or "NullTokenizer",
+        vocab_file=args.vocab_file,
+        merges_file=args.merges_file,
+        tokenizer_model=args.tokenizer_model,
+        make_vocab_size_divisible_by=args.make_vocab_size_divisible_by,
+        tensor_parallel_size=args.tensor_model_parallel_size,
+        null_vocab_size=args.null_vocab_size,
+    )
+    mcfg, pcfg, tcfg, _ = args_to_configs(args, tokenizer.vocab_size)
+
+    initialize_parallel(
+        dp=pcfg.data_parallel_size,
+        pp=pcfg.pipeline_parallel_size,
+        tp=pcfg.tensor_parallel_size,
+        sequence_parallel=pcfg.sequence_parallel,
+    )
+
+    model = model_provider(args, mcfg)
+    params = model.init(jax.random.key(tcfg.seed))
+    if args.load:
+        restored = load_checkpoint(args.load, params, model_cfg=mcfg,
+                                   no_load_optim=True)
+        assert restored is not None, f"no checkpoint found in {args.load}"
+        params = restored[0]
+
+    data = build_dataset(
+        args.task, args.valid_data[0], tokenizer, mcfg.seq_length,
+        overlapping_eval=args.overlapping_eval,
+        strict_lambada=args.strict_lambada,
+    )
+    print(f" > found {len(data)} samples.")
+    evaluate_and_print_results(
+        args.task, model, params, data,
+        micro_batch_size=args.eval_micro_batch_size or args.micro_batch_size,
+        log_interval=args.log_interval,
+    )
+    print("done :-)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Zero-shot GPT evaluation: WikiText-103 perplexity, LAMBADA accuracy.
+
+Parity target: ref tasks/zeroshot_gpt/evaluate.py. The reference drives a
+torch DataLoader through per-rank forward steps with pipeline send/recv
+and a DP all-reduce; here the eval set is fixed-shape arrays and ONE
+jitted step per batch computes either the masked loss sum ('loss' metric)
+or the number of fully-correct cloze samples ('accuracy' metric) — under
+GSPMD the same step runs sharded on any mesh with no explicit collectives.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+
+
+def metric_for_task(task: str) -> str:
+    if task == "LAMBADA":
+        return "accuracy"
+    if task == "WIKITEXT103":
+        return "loss"
+    raise NotImplementedError(f"{task} task is not implemented.")
+
+
+def make_eval_step(model, eval_metric: str):
+    """Batch step -> scalar contribution (ref: forward_step
+    evaluate.py:74-113)."""
+
+    @jax.jit
+    def step(params, tokens, pad_mask):
+        # tokens (b, s+1); pad_mask (b, s)
+        inp, labels = tokens[:, :-1], tokens[:, 1:]
+        logits, _ = model.forward(params, inp)
+        if eval_metric == "loss":
+            losses = vocab_parallel_cross_entropy(logits, labels)
+            return jnp.sum(losses * pad_mask)
+        if eval_metric == "accuracy":
+            pred = jnp.argmax(logits, axis=-1)
+            correct = (pred == labels) | (pad_mask == 0.0)
+            # a sample counts only if every scored position is right
+            # (ref: evaluate.py:106-110 correct.prod(-1))
+            sample_ok = jnp.all(correct, axis=-1)
+            # fully-padded filler rows (batch pad) score 0
+            real = jnp.any(pad_mask > 0.0, axis=-1)
+            return jnp.sum((sample_ok & real).astype(jnp.float32))
+        raise NotImplementedError(eval_metric)
+
+    return step
+
+
+def evaluate(model, params, data, eval_metric: str,
+             micro_batch_size: int = 8, log_interval: int = 100) -> float:
+    """ref: evaluate (evaluate.py:116-139). Pads the sample count up to a
+    batch multiple with zero-mask rows so every step compiles once."""
+    step = make_eval_step(model, eval_metric)
+    n = len(data)
+    b = micro_batch_size
+    n_pad = (-n) % b
+    tokens = np.concatenate(
+        [data.tokens, np.zeros((n_pad,) + data.tokens.shape[1:], np.int32)]
+    )
+    mask = np.concatenate(
+        [data.pad_mask, np.zeros((n_pad,) + data.pad_mask.shape[1:],
+                                 np.float32)]
+    )
+    total = 0.0
+    t0 = time.perf_counter()
+    for it in range(0, len(tokens), b):
+        if (it // b) % log_interval == 0:
+            print(f"> working on iteration: {it // b}", flush=True)
+        total += float(step(params, jnp.asarray(tokens[it:it + b]),
+                            jnp.asarray(mask[it:it + b])))
+    dt = time.perf_counter() - t0
+    print(f"> evaluated {n} samples in {dt:.1f}s", flush=True)
+    return total
+
+
+def evaluate_and_print_results(task: str, model, params, data,
+                               micro_batch_size: int = 8,
+                               log_interval: int = 100) -> dict:
+    """ref: _evaluate_and_print_results (evaluate.py:142-176) — same
+    result-line format, returns the metrics dict for tests."""
+    eval_metric = metric_for_task(task)
+    output = evaluate(model, params, data, eval_metric, micro_batch_size,
+                      log_interval)
+
+    string = f" validation results on {task} | "
+    out: dict = {}
+    if eval_metric == "loss":
+        num_tokenized_tokens = data.num_tokenized_tokens
+        num_original_tokens = data.num_original_tokens
+        val_loss = output / (num_tokenized_tokens - 1)
+        ppl = math.exp(min(20, val_loss))
+        token_ratio = (num_tokenized_tokens - 1) / (num_original_tokens - 1)
+        adjusted_ppl = math.exp(min(20, val_loss * token_ratio))
+        out = {"avg_loss": val_loss, "ppl": ppl,
+               "adjusted_ppl": adjusted_ppl, "token_ratio": token_ratio}
+        string += f"avg loss: {val_loss:.4E} | "
+        string += f"ppl: {ppl:.4E} | "
+        string += f"adjusted ppl: {adjusted_ppl:.4E} | "
+        string += f"token ratio: {token_ratio} |"
+    else:
+        num_examples = len(data)
+        acc = output / num_examples
+        out = {"num_correct": output, "num_examples": num_examples,
+               "accuracy": acc}
+        string += f"number correct: {output:.4E} | "
+        string += f"total examples: {num_examples:.4E} | "
+        string += f"avg accuracy: {acc:.4E}"
+
+    length = len(string) + 1
+    print("-" * length)
+    print(string)
+    print("-" * length, flush=True)
+    return out

@@ -1,0 +1,184 @@
+"""fp16 loss-scaling integration + weight-decay mask + cross-mesh restore.
+
+- fp16: the train step must scale the loss, unscale grads, skip the step
+  on overflow and drive the dynamic scale (ref protocol:
+  Float16OptimizerWithFloat16Params, optimizer/optimizer.py:270-466).
+- weight decay must skip 1D params (norm scales, biases)
+  (ref: get_param_groups optimizer/__init__.py:28-53).
+- checkpoints must restore under a DIFFERENT mesh than they were saved
+  under — the claim that replaces the reference's tools/checkpoint_util.py
+  reshard utility (checkpoint_util.py:106-152).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig, tiny_config
+from megatron_llm_tpu.models import LlamaModel
+from megatron_llm_tpu.optimizer import init_optimizer_state
+from megatron_llm_tpu.optimizer.optimizer import optimizer_step
+from megatron_llm_tpu.training.train_step import make_train_step
+
+
+def _tiny(num_layers=2):
+    return tiny_config(num_layers=num_layers, seq_length=32,
+                       max_position_embeddings=32)
+
+
+def _batch(cfg, key=0):
+    tokens = jax.random.randint(jax.random.key(key), (1, 2, cfg.seq_length),
+                                0, cfg.padded_vocab_size)
+    return {"tokens": tokens, "labels": jnp.roll(tokens, -1, axis=-1)}
+
+
+# ---------------------------------------------------------------------------
+# fp16 scaler integration
+# ---------------------------------------------------------------------------
+
+
+def test_fp16_step_scales_and_grows():
+    cfg = _tiny()
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    tcfg = TrainConfig(micro_batch_size=2, global_batch_size=2, lr=1e-3,
+                       fp16=True, bf16=False, initial_loss_scale=2.0**10,
+                       loss_scale_window=2, hysteresis=2)
+    opt_state = init_optimizer_state(params, tcfg)
+    assert opt_state.scaler is not None
+    step = jax.jit(make_train_step(model, tcfg, ParallelConfig()))
+
+    batch = _batch(cfg)
+    lr, wd = jnp.float32(1e-3), jnp.float32(0.0)
+    p1, s1, st1 = step(params, opt_state, batch, lr, wd)
+    assert float(st1["loss_scale"]) == 2.0**10
+    assert int(st1["skipped"]) == 0
+    assert int(s1.scaler["growth_tracker"]) == 1
+    # params actually moved
+    assert not np.allclose(np.asarray(jax.tree.leaves(p1)[0]),
+                           np.asarray(jax.tree.leaves(params)[0]))
+    # after loss_scale_window clean steps the scale doubles
+    p2, s2, st2 = step(p1, s1, batch, lr, wd)
+    assert float(s2.scaler["scale"]) == 2.0**11
+
+    # grads must equal the unscaled-bf16-free reference within fp32 noise:
+    # compare against a no-scaler run from the same params
+    tcfg_plain = dataclasses.replace(tcfg, fp16=False, bf16=True)
+    step_plain = jax.jit(make_train_step(model, tcfg_plain, ParallelConfig()))
+    opt_plain = init_optimizer_state(params, tcfg_plain)
+    q1, _, _ = step_plain(params, opt_plain, batch, lr, wd)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(q1)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_fp16_overflow_skips_and_backs_off():
+    cfg = _tiny()
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    # poison one weight -> nan loss -> overflow path
+    params = jax.tree.map(lambda x: x, params)
+    params["final_norm"]["scale"] = params["final_norm"]["scale"].at[0].set(
+        jnp.inf
+    )
+    tcfg = TrainConfig(micro_batch_size=2, global_batch_size=2, lr=1e-3,
+                       fp16=True, bf16=False, initial_loss_scale=2.0**10,
+                       hysteresis=1)
+    opt_state = init_optimizer_state(params, tcfg)
+    step = jax.jit(make_train_step(model, tcfg, ParallelConfig()))
+    p1, s1, st1 = step(params, opt_state, _batch(cfg), jnp.float32(1e-3),
+                       jnp.float32(0.0))
+    assert int(st1["skipped"]) == 1
+    # hysteresis=1: first overflow already backs the scale off
+    assert float(s1.scaler["scale"]) == 2.0**9
+    # params untouched on a skipped step
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_weight_decay_skips_1d_params():
+    cfg = _tiny()
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(1))
+    tcfg = TrainConfig(micro_batch_size=2, global_batch_size=2, lr=0.0)
+    opt_state = init_optimizer_state(params, tcfg)
+    zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                              params)
+    # lr>0 + wd>0 + zero grads: only decayed params move
+    p_wd, _, _ = optimizer_step(params, zero_grads, opt_state, tcfg,
+                                jnp.float32(0.1), weight_decay=jnp.float32(0.1))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    flat_wd = jax.tree.leaves(p_wd)
+    for (path, p), p2 in zip(flat, flat_wd):
+        if p.ndim == 1:
+            np.testing.assert_array_equal(np.asarray(p), np.asarray(p2))
+        else:
+            assert not np.allclose(np.asarray(p), np.asarray(p2)), path
+
+
+# ---------------------------------------------------------------------------
+# cross-mesh checkpoint restore (replaces ref tools/checkpoint_util.py)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_restores_under_different_mesh(tmp_path):
+    from megatron_llm_tpu.parallel import initialize_parallel
+    from megatron_llm_tpu.parallel.mesh import destroy_parallel
+    from megatron_llm_tpu.parallel.pipeline import pipeline_param_specs
+    from megatron_llm_tpu.parallel.sharding import param_specs
+    from megatron_llm_tpu.training.checkpointing import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    cfg = _tiny(num_layers=4)
+    model = LlamaModel(cfg)
+    tcfg = TrainConfig(micro_batch_size=2, global_batch_size=2, lr=1e-3)
+
+    # ---- save under dp=2 x pp=2 x tp=2 -------------------------------
+    ctx = initialize_parallel(dp=2, pp=2, tp=2)
+    try:
+        tmpl = jax.eval_shape(model.init, jax.random.key(0))
+        pspecs = pipeline_param_specs(cfg, tmpl)
+        psh = jax.tree.map(lambda s: NamedSharding(ctx.mesh, s), pspecs,
+                           is_leaf=lambda x: isinstance(x, P))
+        params = jax.jit(model.init, out_shardings=psh)(jax.random.key(0))
+        opt_state = init_optimizer_state(params, tcfg)
+        save_checkpoint(str(tmp_path), 7, params, opt_state, cfg)
+        host_params = jax.device_get(params)
+    finally:
+        destroy_parallel()
+
+    # ---- restore under tp=8 ------------------------------------------
+    ctx = initialize_parallel(dp=1, pp=1, tp=8)
+    try:
+        tmpl = jax.eval_shape(model.init, jax.random.key(0))
+        pspecs = param_specs(cfg, tmpl)
+        psh = jax.tree.map(lambda s: NamedSharding(ctx.mesh, s), pspecs,
+                           is_leaf=lambda x: isinstance(x, P))
+        abstract = jax.tree.map(
+            lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s),
+            tmpl, psh,
+        )
+        restored = load_checkpoint(str(tmp_path), abstract)
+        assert restored is not None
+        params_tp8, _, _, iteration = restored
+        assert iteration == 7
+        for a, b in zip(jax.tree.leaves(host_params),
+                        jax.tree.leaves(jax.device_get(params_tp8))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        destroy_parallel()
+
+    # ---- restore single-device (1x1x1) -------------------------------
+    tmpl = jax.eval_shape(model.init, jax.random.key(0))
+    restored = load_checkpoint(str(tmp_path), tmpl)
+    assert restored is not None
+    for a, b in zip(jax.tree.leaves(host_params),
+                    jax.tree.leaves(jax.device_get(restored[0]))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
