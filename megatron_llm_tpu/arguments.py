@@ -47,7 +47,8 @@ def build_base_parser() -> argparse.ArgumentParser:
                                 allow_abbrev=False)
     g = p.add_argument_group("network size")  # ref :406-474
     g.add_argument("--model_name", default="gpt",
-                   choices=["gpt", "llama", "llama2", "codellama", "falcon"])
+                   choices=["gpt", "llama", "llama2", "codellama", "falcon",
+                            "bert", "t5"])
     g.add_argument("--model_size", type=int, default=7)
     g.add_argument("--num_layers", type=int, default=None)
     g.add_argument("--hidden_size", type=int, default=None)
@@ -216,6 +217,18 @@ def args_to_configs(args, padded_vocab_size: int):
     elif name == "falcon":
         mcfg = falcon_config(args.model_size, seq_length=args.seq_length,
                              tp=tp, **overrides)
+    elif name in ("bert", "t5"):
+        from megatron_llm_tpu.config import bert_config, t5_config
+
+        preset = bert_config if name == "bert" else t5_config
+        mcfg = preset(
+            num_layers=overrides.pop("num_layers", 12),
+            hidden_size=overrides.pop("hidden_size", 768),
+            num_attention_heads=overrides.pop("num_attention_heads", 12),
+            seq_length=args.seq_length,
+            tp=tp,
+            **overrides,
+        )
     else:
         mcfg = gpt_config(
             num_layers=overrides.pop("num_layers", 12),

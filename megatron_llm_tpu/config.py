@@ -89,6 +89,11 @@ class ModelConfig:
     use_flash_attn: bool = False  # Pallas flash-attention path
     use_fused_rmsnorm: bool = False  # Pallas fused RMSNorm path
 
+    # BERT/T5 family (ref: --num_tokentypes language_model.py:160-170;
+    # bert_binary_head bert_model.py:130)
+    num_tokentypes: int = 0
+    add_binary_head: bool = False
+
     def __post_init__(self):
         if self.kv_channels is None:
             object.__setattr__(
@@ -392,6 +397,71 @@ def gpt_config(
         max_position_embeddings=seq_length,
         position_embedding_type="absolute",
         hidden_act="gelu",
+        tie_embed_logits=True,
+    )
+    cfg.update(overrides)
+    mc = ModelConfig(**cfg)
+    if mc.padded_vocab_size == 0:
+        mc = dataclasses.replace(mc, padded_vocab_size=mc.pad_vocab_size(vocab_size, tp))
+    return mc
+
+
+def bert_config(
+    num_layers: int = 12,
+    hidden_size: int = 768,
+    num_attention_heads: int = 12,
+    seq_length: int = 512,
+    vocab_size: int = 30522,
+    tp: int = 1,
+    **overrides,
+) -> ModelConfig:
+    """BERT preset (ref: bert_model.py:125-176 through the standard
+    pre-LN ParallelTransformer): learned positions, tokentypes, gelu,
+    biases, binary (SOP) head, tied LM head."""
+    cfg = dict(
+        num_layers=num_layers,
+        hidden_size=hidden_size,
+        num_attention_heads=num_attention_heads,
+        seq_length=seq_length,
+        max_position_embeddings=seq_length,
+        position_embedding_type="absolute",
+        hidden_act="gelu",
+        use_rms_norm=False,
+        use_bias=True,
+        tie_embed_logits=True,
+        num_tokentypes=2,
+        add_binary_head=True,
+    )
+    cfg.update(overrides)
+    mc = ModelConfig(**cfg)
+    if mc.padded_vocab_size == 0:
+        mc = dataclasses.replace(mc, padded_vocab_size=mc.pad_vocab_size(vocab_size, tp))
+    return mc
+
+
+def t5_config(
+    num_layers: int = 12,
+    hidden_size: int = 768,
+    num_attention_heads: int = 12,
+    seq_length: int = 512,
+    decoder_seq_length: int = 128,
+    vocab_size: int = 30522,
+    tp: int = 1,
+    **overrides,
+) -> ModelConfig:
+    """T5 preset (ref: t5_model.py:70-120): shared embeddings, learned
+    positions, gelu, biases. seq_length is the encoder side; the decoder
+    length is a data-pipeline property (ref: --decoder_seq_length)."""
+    cfg = dict(
+        num_layers=num_layers,
+        hidden_size=hidden_size,
+        num_attention_heads=num_attention_heads,
+        seq_length=seq_length,
+        max_position_embeddings=max(seq_length, decoder_seq_length),
+        position_embedding_type="absolute",
+        hidden_act="gelu",
+        use_rms_norm=False,
+        use_bias=True,
         tie_embed_logits=True,
     )
     cfg.update(overrides)

@@ -8,6 +8,9 @@ from megatron_llm_tpu.data.gpt_dataset import (  # noqa: F401
     build_train_valid_test_datasets,
 )
 from megatron_llm_tpu.data.blendable_dataset import BlendableDataset  # noqa: F401
+from megatron_llm_tpu.data.bert_dataset import BertDataset  # noqa: F401
+from megatron_llm_tpu.data.t5_dataset import T5Dataset  # noqa: F401
+from megatron_llm_tpu.data.ict_dataset import ICTDataset  # noqa: F401
 from megatron_llm_tpu.data.data_samplers import (  # noqa: F401
     MegatronPretrainingRandomSampler,
     MegatronPretrainingSampler,

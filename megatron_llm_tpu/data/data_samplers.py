@@ -111,13 +111,17 @@ class PretrainingDataLoader:
     it swappable for a background-thread prefetcher.
     """
 
-    def __init__(self, dataset, sampler, num_microbatches=1):
+    def __init__(self, dataset, sampler, num_microbatches=1, keys=None):
         self.dataset = dataset
         self.sampler = sampler
         # int, or a zero-arg callable consulted each step — that's how the
         # batch-size rampup reaches the loader (ref: the reference re-reads
         # get_num_microbatches() every train_step, training.py:403).
         self.num_microbatches = num_microbatches
+        # None -> GPT 'text' arrays; a list of keys -> dict batches with
+        # every key stacked to (num_micro, mbs*dp, ...) — how the BERT/T5
+        # multi-field samples ride the same loader.
+        self.keys = keys
 
     def __iter__(self):
         it = iter(self.sampler)
@@ -128,14 +132,26 @@ class PretrainingDataLoader:
             try:
                 for _ in range(n):
                     idxs = next(it)
-                    micros.append(
-                        np.stack([self.dataset[i]["text"] for i in idxs]).astype(
-                            np.int32
-                        )
-                    )
+                    if self.keys is None:
+                        micros.append(np.stack(
+                            [self.dataset[i]["text"] for i in idxs]
+                        ).astype(np.int32))
+                    else:
+                        samples = [self.dataset[i] for i in idxs]
+                        micros.append({
+                            k: np.stack([s[k] for s in samples]).astype(
+                                np.int32
+                            )
+                            for k in self.keys
+                        })
             except StopIteration:
                 return
-            yield np.stack(micros)
+            if self.keys is None:
+                yield np.stack(micros)
+            else:
+                yield {
+                    k: np.stack([m[k] for m in micros]) for k in self.keys
+                }
 
 
 def build_pretraining_data_loader(
@@ -146,6 +162,7 @@ def build_pretraining_data_loader(
     num_microbatches=1,  # int or zero-arg callable (rampup)
     dataloader_type: str = "single",
     drop_last: bool = True,
+    keys=None,
 ):
     """ref: build_pretraining_data_loader (data_samplers.py:14-46)."""
     if dataset is None:
@@ -167,4 +184,5 @@ def build_pretraining_data_loader(
         )
     else:
         raise ValueError(f"unknown dataloader type {dataloader_type}")
-    return PretrainingDataLoader(dataset, sampler, num_microbatches)
+    return PretrainingDataLoader(dataset, sampler, num_microbatches,
+                                 keys=keys)

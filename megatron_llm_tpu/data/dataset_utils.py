@@ -1,0 +1,90 @@
+"""Train/valid/test dataset building for BERT and T5 corpora.
+
+Parity target: ref megatron/data/dataset_utils.py
+`build_train_valid_test_datasets` / `_build_train_valid_test_datasets`
+(:421-594): one sentence-level indexed corpus split by DOCUMENT ranges,
+with each split wrapped so its sample maps only cover that range.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from megatron_llm_tpu.data.bert_dataset import BertDataset
+from megatron_llm_tpu.data.gpt_dataset import get_train_valid_test_split_
+from megatron_llm_tpu.data.indexed_dataset import make_dataset
+from megatron_llm_tpu.data.t5_dataset import T5Dataset
+
+
+class DocRangeView:
+    """A doc-range window over a sentence-level indexed dataset.
+
+    The reference mutates the dataset with set_doc_idx (dataset_utils.py
+    :527-560); a read-only view is safer and equally cheap: doc_idx is
+    sliced, sizes/__getitem__ stay absolute (the mapping rows carry
+    absolute sentence indices).
+    """
+
+    def __init__(self, dataset, start_doc: int, end_doc: int):
+        self._ds = dataset
+        self.doc_idx = np.asarray(dataset.doc_idx[start_doc:end_doc + 1],
+                                  np.int64)
+
+    @property
+    def sizes(self):
+        return self._ds.sizes
+
+    def __getitem__(self, idx):
+        return self._ds[idx]
+
+    def __len__(self):
+        return len(self._ds)
+
+
+def build_train_valid_test_datasets(
+    data_prefix,
+    splits_string: str,
+    train_valid_test_num_samples,
+    max_seq_length: int,
+    masked_lm_prob: float,
+    short_seq_prob: float,
+    seed: int,
+    tokenizer,
+    dataset_type: str = "standard_bert",
+    binary_head: bool = True,
+    max_seq_length_dec=None,
+    data_impl: str = "mmap",
+):
+    """ref: dataset_utils.py:421-594 (single-corpus path; blending rides
+    BlendableDataset exactly like GPT)."""
+    if not isinstance(data_prefix, (str,)):
+        assert len(data_prefix) == 1, \
+            "multi-corpus bert/t5 blending: pass one prefix per call"
+        data_prefix = data_prefix[0]
+
+    indexed = make_dataset(data_prefix, data_impl)
+    total_docs = len(indexed.doc_idx) - 1
+    splits = get_train_valid_test_split_(splits_string, total_docs)
+
+    def build_split(index, name):
+        if splits[index + 1] <= splits[index]:
+            return None
+        view = DocRangeView(indexed, splits[index], splits[index + 1])
+        kwargs = dict(
+            name=name,
+            indexed_dataset=view,
+            data_prefix=data_prefix,
+            num_epochs=None,
+            max_num_samples=train_valid_test_num_samples[index],
+            masked_lm_prob=masked_lm_prob,
+            max_seq_length=max_seq_length,
+            short_seq_prob=short_seq_prob,
+            seed=seed,
+            tokenizer=tokenizer,
+        )
+        if dataset_type == "t5":
+            return T5Dataset(max_seq_length_dec=max_seq_length_dec, **kwargs)
+        return BertDataset(binary_head=binary_head, **kwargs)
+
+    return (build_split(0, "train"), build_split(1, "valid"),
+            build_split(2, "test"))

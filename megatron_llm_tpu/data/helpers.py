@@ -64,6 +64,20 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int32,
         ctypes.c_int64,
     ]
+    lib.build_mapping.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.c_uint64, ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.build_mapping.restype = ctypes.c_int64
+    lib.build_blocks_mapping.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.build_blocks_mapping.restype = ctypes.c_int64
     _LIB = lib
     return lib
 
@@ -148,6 +162,69 @@ def build_blending_indices(
         dataset_sample_index[i] = current[best]
         current[best] += 1
     return dataset_index, dataset_sample_index
+
+
+def build_mapping(
+    docs: np.ndarray,  # (n_docs+1,) int64 sentence-boundary offsets
+    sizes: np.ndarray,  # per-sentence token counts, int32
+    num_epochs: int,
+    max_num_samples: int,
+    max_seq_length: int,
+    short_seq_prob: float,
+    seed: int,
+    min_num_sent: int = 2,
+) -> np.ndarray:
+    """(num_samples, 3) int64 rows of (start_sent, end_sent, target_len)
+    for BERT-style pair datasets (ref: helpers.cpp build_mapping
+    :187-452). Two C calls: count, then fill+shuffle."""
+    docs = np.ascontiguousarray(docs, np.int64)
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    lib = _load()
+    assert lib is not None, (
+        "build_mapping requires the native helpers (g++); the reference "
+        "has no python twin for its RNG-dependent mapping either"
+    )
+    n = lib.build_mapping(
+        _ptr(docs, ctypes.c_int64), len(docs), _ptr(sizes, ctypes.c_int32),
+        num_epochs, max_num_samples, max_seq_length, short_seq_prob, seed,
+        min_num_sent, None,
+    )
+    out = np.zeros((n, 3), np.int64)
+    lib.build_mapping(
+        _ptr(docs, ctypes.c_int64), len(docs), _ptr(sizes, ctypes.c_int32),
+        num_epochs, max_num_samples, max_seq_length, short_seq_prob, seed,
+        min_num_sent, _ptr(out, ctypes.c_int64),
+    )
+    return out
+
+
+def build_blocks_mapping(
+    docs: np.ndarray,
+    sizes: np.ndarray,
+    titles_sizes: np.ndarray,  # (n_docs,) int32 title token counts
+    num_epochs: int,
+    max_num_samples: int,
+    max_seq_length: int,
+    seed: int,
+    use_one_sent_blocks: bool = False,
+) -> np.ndarray:
+    """(num_samples, 4) int64 rows of (start_sent, end_sent, doc, block_id)
+    for ICT/REALM block datasets (ref: helpers.cpp build_blocks_mapping
+    :453-680)."""
+    docs = np.ascontiguousarray(docs, np.int64)
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    titles_sizes = np.ascontiguousarray(titles_sizes, np.int32)
+    lib = _load()
+    assert lib is not None, "build_blocks_mapping requires the native helpers"
+    args = (
+        _ptr(docs, ctypes.c_int64), len(docs), _ptr(sizes, ctypes.c_int32),
+        _ptr(titles_sizes, ctypes.c_int32), num_epochs, max_num_samples,
+        max_seq_length, seed, int(use_one_sent_blocks),
+    )
+    n = lib.build_blocks_mapping(*args, None)
+    out = np.zeros((n, 4), np.int64)
+    lib.build_blocks_mapping(*args, _ptr(out, ctypes.c_int64))
+    return out
 
 
 def helpers_available() -> bool:

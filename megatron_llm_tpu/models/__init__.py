@@ -1,3 +1,5 @@
 from megatron_llm_tpu.models.gpt import GPTModel  # noqa: F401
 from megatron_llm_tpu.models.llama import LlamaModel  # noqa: F401
 from megatron_llm_tpu.models.falcon import FalconModel  # noqa: F401
+from megatron_llm_tpu.models.bert import BertModel  # noqa: F401
+from megatron_llm_tpu.models.t5 import T5Model  # noqa: F401

@@ -85,6 +85,53 @@ def grouped_attention(
     return ctx.reshape(b, s, g * qpk * d)
 
 
+def cross_attention_block(
+    attn_params: dict,
+    cfg,
+    hidden: jnp.ndarray,  # (b, s, h) decoder side
+    encoder_output: jnp.ndarray,  # (b, t, h)
+    mask: Optional[jnp.ndarray],  # (b, 1, s, t) True = masked out
+    dropout_rng=None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    """Encoder-decoder cross attention (ref: ParallelAttention with
+    attention_type=cross_attn, transformer.py:331-354, 456-470): Q from the
+    decoder hidden, fused KV from the encoder output, same grouped einsum
+    core as self-attention."""
+    b, s, h = hidden.shape
+    dt = cfg.compute_dtype
+    g, qpk, d = cfg.num_query_groups, cfg.q_per_kv, cfg.head_dim
+
+    q = (hidden @ attn_params["wq"].astype(dt)).reshape(b, s, g, qpk, d)
+    kv = encoder_output @ attn_params["wkv"].astype(dt)
+    if "bq" in attn_params:
+        q = q + attn_params["bq"].astype(dt).reshape(g, qpk, d)
+    if "bkv" in attn_params:
+        kv = kv + attn_params["bkv"].astype(dt)
+    t = encoder_output.shape[1]
+    kv = kv.reshape(b, t, g, 2, d)
+    k, v = kv[:, :, :, 0], kv[:, :, :, 1]
+    q = shard_activation(q, "groups")
+    ctx = grouped_attention(q, k, v, mask, cfg, dropout_rng, deterministic)
+    out = ctx @ attn_params["wo"].astype(dt)
+    if "bo" in attn_params:
+        out = out + attn_params["bo"].astype(dt)
+    return out
+
+
+def padding_mask_2d(q_keep: jnp.ndarray,
+                    k_keep: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Keep-masks (b, s_q) [x (b, s_k)] {0,1} -> (b, 1, s_q, s_k)
+    True-=-masked, the outer-product form (ref:
+    bert_extended_attention_mask bert_model.py:21-35 and the enc-dec
+    cross mask, t5_dataset.py make_attention_mask)."""
+    if k_keep is None:
+        k_keep = q_keep
+    keep = q_keep.astype(jnp.float32)[:, :, None] * \
+        k_keep.astype(jnp.float32)[:, None, :]
+    return (keep < 0.5)[:, None]
+
+
 def causal_mask(s: int, t: Optional[int] = None, offset: int = 0) -> jnp.ndarray:
     """(s, t) boolean mask, True = masked (ref convention:
     utils.py:137-196 builds mask with `< 0.5` => masked True)."""

@@ -1,0 +1,168 @@
+"""BERT model: bidirectional encoder + masked-LM head + binary (SOP) head.
+
+Parity target: ref megatron/model/bert_model.py:125-242 (`BertModel`,
+`BertLMHead`, `post_language_model_processing`) and the pooler
+(language_model.py:97-130). Structure:
+
+- padding (non-causal) attention from the 2D keep-mask's outer product
+  (ref: bert_extended_attention_mask :21-35);
+- learned absolute positions + tokentype (segment) embeddings;
+- pooler: tanh(dense(hidden[:, 0])) feeding the 2-way binary head
+  (NSP/SOP, ref: Pooler language_model.py:97-130);
+- BertLMHead: dense -> gelu -> layernorm -> logits against the TIED word
+  embedding table plus a vocab bias (ref: BertLMHead :47-92).
+
+The reference runs this through the same ParallelTransformer as GPT; here
+it is the same transformer_stack — post/pre-LN, biases, gelu all come
+from the shared config.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import ModelConfig
+from megatron_llm_tpu.models.activations import ACTIVATIONS
+from megatron_llm_tpu.models.attention import padding_mask_2d
+from megatron_llm_tpu.models.language_model import (
+    embed_tokens,
+    init_language_model_params,
+)
+from megatron_llm_tpu.models.norms import apply_norm, layer_norm
+from megatron_llm_tpu.models.transformer import transformer_stack
+from megatron_llm_tpu.parallel.cross_entropy import (
+    cross_entropy,
+    vocab_parallel_cross_entropy,
+)
+from megatron_llm_tpu.parallel.mesh import shard_activation
+
+
+def _normal(key, shape, std, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+class BertModel:
+    """ref: BertModel bert_model.py:125-242."""
+
+    def __init__(self, cfg: ModelConfig):
+        assert cfg.position_embedding_type == "absolute", \
+            "BERT uses learned absolute positions (ref bert_model.py:183)"
+        assert cfg.tie_embed_logits, "BERT LM head ties to word embeddings"
+        self.cfg = cfg
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.cfg
+        params = init_language_model_params(cfg, rng)
+        k1, k2, k3, k4 = jax.random.split(jax.random.fold_in(rng, 17), 4)
+        std, dt, h = cfg.init_method_std, cfg.params_dtype, cfg.hidden_size
+        # BertLMHead (ref :47-92): dense + LN + vocab bias
+        params["lm_head"] = {
+            "dense_w": _normal(k1, (h, h), std, dt),
+            "dense_b": jnp.zeros((h,), dt),
+            "norm": {"scale": jnp.ones((h,), dt),
+                     "bias": jnp.zeros((h,), dt)},
+            "bias": jnp.zeros((cfg.padded_vocab_size,), dt),
+        }
+        if cfg.add_binary_head:
+            # pooler (language_model.py:97-130) + 2-way head (:176-180)
+            params["pooler"] = {
+                "w": _normal(k2, (h, h), std, dt),
+                "b": jnp.zeros((h,), dt),
+            }
+            params["binary_head"] = {
+                "w": _normal(k3, (h, 2), std, dt),
+                "b": jnp.zeros((2,), dt),
+            }
+        return params
+
+    def encode(self, params, tokens, attention_mask=None, tokentype_ids=None,
+               dropout_rng=None, deterministic=True) -> jnp.ndarray:
+        """Run the bidirectional encoder -> (b, s, h) final hidden."""
+        cfg = self.cfg
+        b, s = tokens.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), jnp.int32)
+        mask4 = padding_mask_2d(attention_mask)
+
+        if dropout_rng is not None:
+            emb_rng, stack_rng = jax.random.split(dropout_rng)
+        else:
+            emb_rng = stack_rng = None
+        hidden = embed_tokens(params, cfg, tokens, None, emb_rng,
+                              deterministic, tokentype_ids=tokentype_ids)
+        hidden, _ = transformer_stack(
+            params["layers"], cfg, hidden, None, mask4, None,
+            stack_rng, deterministic,
+        )
+        return apply_norm(hidden, params["final_norm"], cfg)
+
+    def forward(
+        self,
+        params: dict,
+        tokens: jnp.ndarray,  # (b, s)
+        attention_mask: Optional[jnp.ndarray] = None,  # (b, s) keep-mask
+        tokentype_ids: Optional[jnp.ndarray] = None,
+        dropout_rng=None,
+        deterministic: bool = True,
+    ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+        """Returns (lm_logits (b, s, V), binary_logits (b, 2) | None)
+        (ref: BertModel.forward :178-205)."""
+        cfg = self.cfg
+        hidden = self.encode(params, tokens, attention_mask, tokentype_ids,
+                             dropout_rng, deterministic)
+
+        # BertLMHead (ref :83-92)
+        lh = params["lm_head"]
+        dt = cfg.compute_dtype
+        x = hidden @ lh["dense_w"].astype(dt) + lh["dense_b"].astype(dt)
+        x = ACTIVATIONS["gelu"](x)
+        x = layer_norm(x, lh["norm"]["scale"], lh["norm"]["bias"],
+                       cfg.layernorm_epsilon)
+        emb = params["embedding"]["word_embeddings"].astype(dt)
+        logits = x @ emb.T + lh["bias"].astype(dt)
+        logits = shard_activation(logits, "logits")
+
+        binary_logits = None
+        if cfg.add_binary_head:
+            pooled = jnp.tanh(
+                hidden[:, 0] @ params["pooler"]["w"].astype(dt)
+                + params["pooler"]["b"].astype(dt)
+            )
+            binary_logits = (
+                pooled @ params["binary_head"]["w"].astype(dt)
+                + params["binary_head"]["b"].astype(dt)
+            )
+        return logits, binary_logits
+
+    def loss(
+        self,
+        params: dict,
+        tokens: jnp.ndarray,
+        labels: jnp.ndarray,  # (b, s) masked-LM targets
+        loss_mask: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        tokentype_ids: Optional[jnp.ndarray] = None,
+        sop_labels: Optional[jnp.ndarray] = None,  # (b,) 0/1
+        dropout_rng=None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        """lm_loss + sop_loss (ref: loss_func pretrain_bert.py:71-91 —
+        both terms are masked/plain means, summed)."""
+        logits, binary_logits = self.forward(
+            params, tokens, attention_mask, tokentype_ids, dropout_rng,
+            deterministic,
+        )
+        losses = vocab_parallel_cross_entropy(logits, labels)
+        if loss_mask is None:
+            lm_loss = jnp.mean(losses)
+        else:
+            lm = loss_mask.astype(jnp.float32)
+            lm_loss = jnp.sum(losses * lm) / jnp.maximum(jnp.sum(lm), 1.0)
+        if binary_logits is not None and sop_labels is not None:
+            sop_losses = cross_entropy(binary_logits.astype(jnp.float32),
+                                       sop_labels)
+            return lm_loss + jnp.mean(sop_losses)
+        return lm_loss

@@ -1,0 +1,116 @@
+"""Biencoder (ICT / retriever) model: two BERT towers + retrieval loss.
+
+Parity target: ref megatron/model/biencoder_model.py —
+`PretrainedBertModel` (:255-320: CLS-token pooling + optional projection)
+and `BiEncoderModel` (:71-160: query tower + context tower, optionally
+shared). The ICT pretraining loss is in-batch softmax retrieval
+(ref: pretrain_ict.py:68-86: query·contextᵀ logits, diagonal targets).
+
+Functionally the towers are the shared BertModel's encoder; parameters
+are {"query": <bert params>, "context": <bert params>} or a single
+{"shared": ...} tree, plus optional projection matrices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import ModelConfig
+from megatron_llm_tpu.models.bert import BertModel
+from megatron_llm_tpu.parallel.cross_entropy import cross_entropy
+
+
+class BiEncoderModel:
+    """ref: BiEncoderModel biencoder_model.py:71-160."""
+
+    def __init__(self, cfg: ModelConfig, projection_dim: int = 0,
+                 shared_query_context_model: bool = False):
+        # towers are headless BERT encoders
+        self.cfg = cfg
+        self.projection_dim = projection_dim
+        self.shared = shared_query_context_model
+        self.bert = BertModel(cfg)
+
+    def _init_tower(self, rng):
+        params = self.bert.init(rng)
+        # towers carry no LM/binary heads
+        params.pop("lm_head", None)
+        params.pop("binary_head", None)
+        params.pop("pooler", None)
+        if self.projection_dim > 0:
+            params["projection_enc"] = {
+                "w": (jax.random.normal(
+                    jax.random.fold_in(rng, 5),
+                    (self.cfg.hidden_size, self.projection_dim), jnp.float32,
+                ) * self.cfg.init_method_std).astype(self.cfg.params_dtype),
+                "b": jnp.zeros((self.projection_dim,),
+                               self.cfg.params_dtype),
+            }
+        return params
+
+    def init(self, rng: jax.Array) -> dict:
+        if self.shared:
+            return {"shared": self._init_tower(rng)}
+        kq, kc = jax.random.split(rng)
+        return {"query": self._init_tower(kq),
+                "context": self._init_tower(kc)}
+
+    def embed_text(self, tower_params, tokens, attention_mask=None,
+                   tokentype_ids=None, dropout_rng=None,
+                   deterministic=True) -> jnp.ndarray:
+        """CLS-token embedding, optionally projected
+        (ref: PretrainedBertModel.forward :297-319)."""
+        hidden = self.bert.encode(tower_params, tokens, attention_mask,
+                                  tokentype_ids, dropout_rng, deterministic)
+        pooled = hidden[:, 0]
+        if self.projection_dim > 0:
+            pooled = (
+                pooled @ tower_params["projection_enc"]["w"].astype(
+                    self.cfg.compute_dtype
+                )
+                + tower_params["projection_enc"]["b"].astype(
+                    self.cfg.compute_dtype
+                )
+            )
+        return pooled
+
+    def forward(
+        self,
+        params: dict,
+        query_tokens: jnp.ndarray,
+        query_attention_mask: Optional[jnp.ndarray],
+        query_types: Optional[jnp.ndarray],
+        context_tokens: jnp.ndarray,
+        context_attention_mask: Optional[jnp.ndarray],
+        context_types: Optional[jnp.ndarray],
+        dropout_rng=None,
+        deterministic: bool = True,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(query_logits (b, d), context_logits (b, d))
+        (ref: BiEncoderModel.forward :123-143)."""
+        qp = params["shared"] if self.shared else params["query"]
+        cp = params["shared"] if self.shared else params["context"]
+        if dropout_rng is not None:
+            rq, rc = jax.random.split(dropout_rng)
+        else:
+            rq = rc = None
+        q = self.embed_text(qp, query_tokens, query_attention_mask,
+                            query_types, rq, deterministic)
+        c = self.embed_text(cp, context_tokens, context_attention_mask,
+                            context_types, rc, deterministic)
+        return q, c
+
+    def loss(self, params, query_tokens, query_mask, context_tokens,
+             context_mask, dropout_rng=None,
+             deterministic: bool = True) -> jnp.ndarray:
+        """In-batch retrieval CE: each query's positive is its own block
+        (ref: pretrain_ict.py:68-86)."""
+        q, c = self.forward(params, query_tokens, query_mask, None,
+                            context_tokens, context_mask, None,
+                            dropout_rng, deterministic)
+        scores = q.astype(jnp.float32) @ c.astype(jnp.float32).T  # (b, b)
+        targets = jnp.arange(scores.shape[0])
+        return jnp.mean(cross_entropy(scores, targets))

@@ -1,0 +1,227 @@
+"""T5 encoder-decoder model.
+
+Parity target: ref megatron/model/t5_model.py:70-198 (`T5Model`,
+`T5LMHead`) plus the decoder layer structure of
+transformer.py:695-817 with layer_type=decoder:
+
+    h = h + self_attn(input_norm(h))          (causal+padding mask)
+    h = h + cross_attn(post_attention_norm(h), encoder_out)
+    h = h + mlp(post_cross_norm(h))
+
+Shared word-embedding table between encoder and decoder (the reference's
+initialize_word_embeddings), learned absolute positions on both sides,
+logits tied to the embedding plus a vocab bias (T5LMHead :40-67). Masks
+enter as 2D keep-masks and the 4D forms are built here
+(ref: t5_extended_attention_mask :21-27 over the dataset's
+make_attention_mask products, t5_dataset.py:91-99).
+
+The decoder is a scan over stacked decoder layers, same compile-once
+design as the GPT stack.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import ModelConfig
+from megatron_llm_tpu.models.attention import (
+    attention_block,
+    cross_attention_block,
+    padding_mask_2d,
+)
+from megatron_llm_tpu.models.language_model import (
+    embed_tokens,
+    init_language_model_params,
+)
+from megatron_llm_tpu.models.norms import apply_norm
+from megatron_llm_tpu.models.transformer import (
+    init_layer_params,
+    init_norm_params,
+    mlp_block,
+    transformer_stack,
+)
+from megatron_llm_tpu.parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from megatron_llm_tpu.parallel.mesh import shard_activation
+
+
+def _normal(key, shape, std, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+def init_decoder_layer_params(cfg, key, num_layers: Optional[int] = None):
+    """Stacked decoder layers: self-attn params (from the shared init)
+    plus cross-attention (wq / fused wkv / wo) and a third norm."""
+    L = num_layers if num_layers is not None else cfg.num_layers
+    layers = init_layer_params(cfg, key, num_layers=L)
+    h, d = cfg.hidden_size, cfg.head_dim
+    g, qpk = cfg.num_query_groups, cfg.q_per_kv
+    std = cfg.init_method_std
+    out_std = (std / jnp.sqrt(2.0 * cfg.num_layers)
+               if cfg.use_scaled_init_method else std)
+    k1, k2, k3 = jax.random.split(jax.random.fold_in(key, 99), 3)
+    dt = cfg.params_dtype
+    cross = {
+        "wq": _normal(k1, (L, h, g * qpk * d), std, dt),
+        "wkv": _normal(k2, (L, h, g * 2 * d), std, dt),
+        "wo": _normal(k3, (L, g * qpk * d, h), out_std, dt),
+    }
+    if cfg.use_bias:
+        cross["bq"] = jnp.zeros((L, g * qpk * d), dt)
+        cross["bkv"] = jnp.zeros((L, g * 2 * d), dt)
+        cross["bo"] = jnp.zeros((L, h), dt)
+    layers["cross_attention"] = cross
+    layers["post_cross_norm"] = init_norm_params(cfg, (L,))
+    return layers
+
+
+def decoder_stack(layer_params, cfg, hidden, encoder_output, self_mask,
+                  cross_mask, dropout_rng=None, deterministic=True):
+    """Scan the stacked decoder layers (ref: ParallelTransformer with
+    layer_type=decoder, transformer.py:695-817)."""
+
+    def body(carry, xs):
+        (h,) = carry
+        p, idx = xs
+        if dropout_rng is not None:
+            rng = jax.random.fold_in(dropout_rng, idx)
+            r1, r2, r3 = jax.random.split(rng, 3)
+        else:
+            r1 = r2 = r3 = None
+        # self attention (causal + padding)
+        normed = apply_norm(h, p["input_norm"], cfg)
+        attn_out, _ = attention_block(
+            p["attention"], cfg, normed, None, self_mask, None, r1,
+            deterministic, None,
+        )
+        h = h + attn_out
+        # cross attention over the encoder output
+        normed = apply_norm(h, p["post_attention_norm"], cfg)
+        h = h + cross_attention_block(
+            p["cross_attention"], cfg, normed, encoder_output, cross_mask,
+            r2, deterministic,
+        )
+        # mlp
+        normed = apply_norm(h, p["post_cross_norm"], cfg)
+        h = h + mlp_block(p["mlp"], cfg, normed, r3, deterministic)
+        h = shard_activation(h, "hidden")
+        return (h,), None
+
+    if cfg.recompute_granularity == "full":
+        body = jax.checkpoint(body, prevent_cse=False)
+    L = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+    (hidden,), _ = jax.lax.scan(body, (hidden,),
+                                (layer_params, jnp.arange(L)))
+    return hidden
+
+
+
+
+class T5Model:
+    """ref: T5Model t5_model.py:70-198."""
+
+    def __init__(self, cfg: ModelConfig):
+        assert cfg.position_embedding_type == "absolute", \
+            "megatron T5 uses learned absolute positions"
+        assert cfg.tie_embed_logits, "T5 LM head ties to word embeddings"
+        self.cfg = cfg
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.cfg
+        params = init_language_model_params(cfg, rng)
+        k_dec = jax.random.fold_in(rng, 23)
+        params["decoder_layers"] = init_decoder_layer_params(cfg, k_dec)
+        params["decoder_final_norm"] = init_norm_params(cfg)
+        # T5LMHead vocab bias (ref :55-58)
+        params["lm_head_bias"] = jnp.zeros((cfg.padded_vocab_size,),
+                                           cfg.params_dtype)
+        return params
+
+    def forward(
+        self,
+        params: dict,
+        encoder_input_ids: jnp.ndarray,  # (b, s_e)
+        decoder_input_ids: jnp.ndarray,  # (b, s_d)
+        encoder_attn_mask: Optional[jnp.ndarray] = None,  # (b, s_e) keep
+        decoder_attn_mask: Optional[jnp.ndarray] = None,  # (b, s_d) keep
+        dropout_rng=None,
+        deterministic: bool = True,
+        enc_hidden_states: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Returns (lm_logits (b, s_d, V), encoder_output (b, s_e, h))
+        (ref: T5Model.forward :121-166)."""
+        cfg = self.cfg
+        b, s_e = encoder_input_ids.shape
+        s_d = decoder_input_ids.shape[1]
+        if encoder_attn_mask is None:
+            encoder_attn_mask = jnp.ones((b, s_e), jnp.int32)
+        if decoder_attn_mask is None:
+            decoder_attn_mask = jnp.ones((b, s_d), jnp.int32)
+
+        if dropout_rng is not None:
+            r_enc_e, r_enc, r_dec_e, r_dec = jax.random.split(dropout_rng, 4)
+        else:
+            r_enc_e = r_enc = r_dec_e = r_dec = None
+
+        # ---- encoder (padding mask) ----------------------------------
+        if enc_hidden_states is None:
+            enc_mask = padding_mask_2d(encoder_attn_mask)
+            enc_h = embed_tokens(params, cfg, encoder_input_ids, None,
+                                 r_enc_e, deterministic)
+            enc_h, _ = transformer_stack(
+                params["layers"], cfg, enc_h, None, enc_mask, None,
+                r_enc, deterministic,
+            )
+            enc_out = apply_norm(enc_h, params["final_norm"], cfg)
+        else:
+            enc_out = enc_hidden_states
+
+        # ---- decoder (causal+padding self mask, enc-dec cross mask) ---
+        causal = jnp.tril(jnp.ones((s_d, s_d), jnp.float32))
+        dec_keep = decoder_attn_mask.astype(jnp.float32)
+        self_keep = (dec_keep[:, :, None] * dec_keep[:, None, :]
+                     * causal[None])
+        self_mask = (self_keep < 0.5)[:, None]
+        cross_mask = padding_mask_2d(decoder_attn_mask, encoder_attn_mask)
+
+        dec_h = embed_tokens(params, cfg, decoder_input_ids, None, r_dec_e,
+                             deterministic)
+        dec_h = decoder_stack(
+            params["decoder_layers"], cfg, dec_h, enc_out, self_mask,
+            cross_mask, r_dec, deterministic,
+        )
+        dec_h = apply_norm(dec_h, params["decoder_final_norm"], cfg)
+
+        emb = params["embedding"]["word_embeddings"].astype(cfg.compute_dtype)
+        logits = dec_h @ emb.T + params["lm_head_bias"].astype(
+            cfg.compute_dtype
+        )
+        return shard_activation(logits, "logits"), enc_out
+
+    def loss(
+        self,
+        params: dict,
+        encoder_input_ids: jnp.ndarray,
+        decoder_input_ids: jnp.ndarray,
+        lm_labels: jnp.ndarray,  # (b, s_d)
+        loss_mask: Optional[jnp.ndarray] = None,
+        encoder_attn_mask: Optional[jnp.ndarray] = None,
+        decoder_attn_mask: Optional[jnp.ndarray] = None,
+        dropout_rng=None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        """Masked mean CE over decoder positions (ref: loss_func
+        pretrain_t5.py:76-85)."""
+        logits, _ = self.forward(
+            params, encoder_input_ids, decoder_input_ids,
+            encoder_attn_mask, decoder_attn_mask, dropout_rng, deterministic,
+        )
+        losses = vocab_parallel_cross_entropy(logits, lm_labels)
+        if loss_mask is None:
+            return jnp.mean(losses)
+        lm = loss_mask.astype(jnp.float32)
+        return jnp.sum(losses * lm) / jnp.maximum(jnp.sum(lm), 1.0)

@@ -29,7 +29,9 @@ from megatron_llm_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, ParallelContex
 
 
 def param_specs(cfg, params: dict) -> dict:
-    """PartitionSpec pytree matching a language-model param tree."""
+    """PartitionSpec pytree matching a language-model param tree (GPT/
+    Llama/Falcon, BERT incl. heads, T5 incl. decoder, and biencoder
+    query/context/shared towers). Unknown leaves default to replicated."""
 
     def layer_specs(layers: dict) -> dict:
         specs: dict = {
@@ -53,21 +55,51 @@ def param_specs(cfg, params: dict) -> dict:
                 mlp["b1"] = P(None, MODEL_AXIS)
                 mlp["b2"] = P(None, None)
         specs["mlp"] = mlp
-        for name in ("post_attention_norm", "mlp_norm"):
+        if "cross_attention" in layers:
+            # T5 decoder: q/kv column-parallel, output row-parallel
+            # (ref: ParallelAttention cross_attn transformer.py:331-354)
+            cross = {
+                "wq": P(None, None, MODEL_AXIS),
+                "wkv": P(None, None, MODEL_AXIS),
+                "wo": P(None, MODEL_AXIS, None),
+            }
+            if "bq" in layers["cross_attention"]:
+                cross["bq"] = P(None, MODEL_AXIS)
+                cross["bkv"] = P(None, MODEL_AXIS)
+                cross["bo"] = P(None, None)
+            specs["cross_attention"] = cross
+        for name in ("post_attention_norm", "mlp_norm", "post_cross_norm"):
             if name in layers:
                 specs[name] = jax.tree.map(lambda _: P(), layers[name])
         return specs
 
-    specs: dict = {
-        "embedding": {"word_embeddings": P(MODEL_AXIS, None)},
-        "layers": layer_specs(params["layers"]),
-        "final_norm": jax.tree.map(lambda _: P(), params["final_norm"]),
-    }
-    if "position_embeddings" in params["embedding"]:
-        specs["embedding"]["position_embeddings"] = P(None, None)
-    if "lm_head" in params:
-        specs["lm_head"] = P(None, MODEL_AXIS)
-    return specs
+    def tower_specs(tree: dict) -> dict:
+        specs: dict = {}
+        for key, val in tree.items():
+            if key in ("layers", "decoder_layers"):
+                specs[key] = layer_specs(val)
+            elif key == "embedding":
+                emb = {"word_embeddings": P(MODEL_AXIS, None)}
+                for name in ("position_embeddings", "tokentype_embeddings"):
+                    if name in val:
+                        emb[name] = P(None, None)
+                specs[key] = emb
+            elif key == "lm_head" and not isinstance(val, dict):
+                specs[key] = P(None, MODEL_AXIS)
+            elif key == "lm_head" and isinstance(val, dict):
+                # BertLMHead: dense replicated, vocab bias model-sharded
+                specs[key] = jax.tree.map(lambda _: P(), val)
+                specs[key]["bias"] = P(MODEL_AXIS)
+            elif key == "lm_head_bias":
+                specs[key] = P(MODEL_AXIS)
+            else:
+                # norms, pooler, binary_head, projections: replicated
+                specs[key] = jax.tree.map(lambda _: P(), val)
+        return specs
+
+    if set(params) <= {"query", "context", "shared"}:  # biencoder towers
+        return {k: tower_specs(v) for k, v in params.items()}
+    return tower_specs(params)
 
 
 def param_shardings(ctx: ParallelContext, cfg, params: dict) -> dict:

@@ -301,6 +301,7 @@ def build_tokenizer(
     vocab_extra_ids_list: Optional[str] = None,
     new_tokens: bool = True,
     null_vocab_size: Optional[int] = None,
+    vocab_extra_ids: int = 0,
 ):
     """ref: build_tokenizer (tokenizer.py:12-47). Returns tokenizer with
     `padded_vocab_size` attribute set."""
@@ -317,9 +318,11 @@ def build_tokenizer(
     elif tokenizer_type == "HFTokenizer":
         tokenizer = _HFTokenizer(tokenizer_model or vocab_file)
     elif tokenizer_type == "BertWordPieceLowerCase":
-        tokenizer = _BertWordPieceTokenizer(vocab_file, lower_case=True)
+        tokenizer = _BertWordPieceTokenizer(vocab_file, lower_case=True,
+                                            vocab_extra_ids=vocab_extra_ids)
     elif tokenizer_type == "BertWordPieceCase":
-        tokenizer = _BertWordPieceTokenizer(vocab_file, lower_case=False)
+        tokenizer = _BertWordPieceTokenizer(vocab_file, lower_case=False,
+                                            vocab_extra_ids=vocab_extra_ids)
     elif tokenizer_type == "NullTokenizer":
         tokenizer = _NullTokenizer(null_vocab_size or 0)
     else:
@@ -336,7 +339,8 @@ class _BertWordPieceTokenizer(AbstractTokenizer):
     bert_tokenization.py). Compact re-implementation: basic whitespace/punct
     split then greedy longest-match wordpieces."""
 
-    def __init__(self, vocab_file: str, lower_case: bool = True):
+    def __init__(self, vocab_file: str, lower_case: bool = True,
+                 vocab_extra_ids: int = 0):
         super().__init__(
             "BERT Lower Case" if lower_case else "BERT Upper Case"
         )
@@ -347,12 +351,23 @@ class _BertWordPieceTokenizer(AbstractTokenizer):
                 tok = line.rstrip("\n")
                 if tok:
                     self._vocab[tok] = i
-        self._inv = {v: k for k, v in self._vocab.items()}
         self.cls_id = self._vocab["[CLS]"]
         self.sep_id = self._vocab["[SEP]"]
         self.pad_id = self._vocab["[PAD]"]
         self.mask_id = self._vocab["[MASK]"]
         self.unk_id = self._vocab.get("[UNK]", 0)
+        # [BOS]/[EOS] + <extra_id_N> sentinels for T5 span corruption
+        # (ref: tokenizer.py:137-166)
+        for tok in ("[BOS]", "[EOS]"):
+            self._vocab.setdefault(tok, len(self._vocab))
+        self._bos_token_id = self._vocab["[BOS]"]
+        self._eos_token_id = self._vocab["[EOS]"]
+        self._additional_special_tokens_ids = []
+        for i in range(vocab_extra_ids):
+            tok = f"<extra_id_{i}>"
+            self._vocab.setdefault(tok, len(self._vocab))
+            self._additional_special_tokens_ids.append(self._vocab[tok])
+        self._inv = {v: k for k, v in self._vocab.items()}
 
     # -- basic tokenization ------------------------------------------------
     @staticmethod
@@ -453,3 +468,15 @@ class _BertWordPieceTokenizer(AbstractTokenizer):
     @property
     def eod(self):
         return self.sep_id
+
+    @property
+    def bos_token_id(self):
+        return self._bos_token_id
+
+    @property
+    def eos_token_id(self):
+        return self._eos_token_id
+
+    @property
+    def additional_special_tokens_ids(self):
+        return self._additional_special_tokens_ids

@@ -47,15 +47,15 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
     scaler = get_grad_scaler(tcfg)
 
     def loss_on_micro(params, micro, rng, loss_scale):
+        # the batch dict's keys ARE the model-loss kwargs: GPT batches
+        # carry tokens/labels/loss_mask/position_ids/attention_mask, BERT
+        # adds tokentype_ids/sop_labels, T5 uses encoder/decoder fields —
+        # one train step serves every model family.
         loss = model.loss(
             params,
-            micro["tokens"],
-            micro["labels"],
-            loss_mask=micro.get("loss_mask"),
-            position_ids=micro.get("position_ids"),
-            attention_mask=micro.get("attention_mask"),
             dropout_rng=rng,
             deterministic=rng is None,
+            **micro,
         )
         if loss_scale is not None:
             # ref: MegatronOptimizer.scale_loss optimizer.py:116-120

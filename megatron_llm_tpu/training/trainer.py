@@ -103,6 +103,7 @@ class Trainer:
         reset_position_ids: bool = False,
         reset_attention_mask: bool = False,
         eod_mask_loss: bool = False,
+        batch_builder=None,
     ):
         self.model = model
         self.cfg: ModelConfig = model.cfg
@@ -110,6 +111,10 @@ class Trainer:
         self.pcfg = pcfg
         self.train_data_iterator = train_data_iterator
         self.valid_data_iterator = valid_data_iterator
+        # raw loader batch -> model-loss kwargs dict; None = GPT get_batch
+        # (how pretrain_bert/pretrain_t5 reuse this loop with their own
+        # multi-field samples, ref: each entry point's get_batch)
+        self.batch_builder = batch_builder
         self.eod_token = eod_token
         self.reset_position_ids = reset_position_ids
         self.reset_attention_mask = reset_attention_mask
@@ -256,12 +261,17 @@ class Trainer:
     # ------------------------------------------------------------------
     def train_step(self, state: TrainState, text: np.ndarray, dropout_rng=None):
         """One optimizer step over a global batch 'text'
-        (num_micro, mbs*dp, seq+1) (ref: train_step training.py:391-450)."""
-        num_micro = text.shape[0]
-        batch = get_batch(
-            text, self.eod_token, self.reset_position_ids,
-            self.reset_attention_mask, self.eod_mask_loss,
-        )
+        (num_micro, mbs*dp, seq+1) array, or a dict of such arrays when a
+        batch_builder is installed (ref: train_step training.py:391-450)."""
+        if self.batch_builder is not None:
+            batch = self.batch_builder(text)
+            num_micro = jax.tree.leaves(batch)[0].shape[0]
+        else:
+            num_micro = text.shape[0]
+            batch = get_batch(
+                text, self.eod_token, self.reset_position_ids,
+                self.reset_attention_mask, self.eod_mask_loss,
+            )
         lr, wd = self.scheduler.get_lr(), self.scheduler.get_wd()
         step_fn = self._get_step_fn(num_micro)
         params, opt_state, stats = step_fn(
@@ -272,20 +282,40 @@ class Trainer:
         state.params = params
         state.opt_state = opt_state
         state.iteration += 1
-        state.consumed_train_samples += num_micro * text.shape[1]
+        mbs_dp = jax.tree.leaves(batch)[0].shape[1]
+        state.consumed_train_samples += num_micro * mbs_dp
         self.num_microbatches_calc.update(state.consumed_train_samples)
         stats["lr"] = lr
-        stats["batch_size"] = num_micro * text.shape[1]
+        stats["batch_size"] = num_micro * mbs_dp
         return stats
 
     def evaluate(self, state: TrainState, max_iters: Optional[int] = None) -> float:
-        """ref: evaluate (training.py:754-853)."""
+        """ref: evaluate (training.py:754-853). With a batch_builder
+        installed (BERT/T5/biencoder), the eval step runs the model's own
+        loss kwargs per microbatch instead of the GPT path."""
         if self.valid_data_iterator is None:
             return float("nan")
         if self._eval_step_fn is None:
-            from megatron_llm_tpu.training.train_step import make_eval_step
+            if self.batch_builder is not None:
+                model = self.model
 
-            self._eval_step_fn = jax.jit(make_eval_step(self.model))
+                @jax.jit
+                def generic_eval(params, batch):
+                    n = jax.tree.leaves(batch)[0].shape[0]
+                    losses = [
+                        model.loss(params, deterministic=True,
+                                   **jax.tree.map(lambda x: x[i], batch))
+                        for i in range(n)
+                    ]
+                    return sum(losses) / len(losses)
+
+                self._eval_step_fn = generic_eval
+            else:
+                from megatron_llm_tpu.training.train_step import (
+                    make_eval_step,
+                )
+
+                self._eval_step_fn = jax.jit(make_eval_step(self.model))
         eval_step = self._eval_step_fn
         total, count = 0.0, 0
         iters = max_iters if max_iters is not None else self.tcfg.eval_iters
@@ -295,9 +325,15 @@ class Trainer:
                 text = next(it)
             except StopIteration:
                 break
-            batch = get_batch(text, self.eod_token)
-            micro = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), batch)
-            total += float(eval_step(state.params, micro))
+            if self.batch_builder is not None:
+                total += float(eval_step(state.params,
+                                         self.batch_builder(text)))
+            else:
+                batch = get_batch(text, self.eod_token)
+                micro = jax.tree.map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), batch
+                )
+                total += float(eval_step(state.params, micro))
             count += 1
         return total / max(count, 1)
 

@@ -1,0 +1,286 @@
+"""BERT/T5/ICT data pipeline: C++ sample maps, masked-LM construction,
+dataset field contracts, and a pretrain_bert end-to-end smoke run.
+
+Ref analogues: the masking semantics of dataset_utils.py:187-419, the
+sample shapes of bert_dataset.py:80-182 / t5_dataset.py:80-144 /
+ict_dataset.py:50-158.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.data.helpers import (
+    build_blocks_mapping,
+    build_mapping,
+    helpers_available,
+)
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDatasetBuilder,
+    make_dataset,
+)
+from megatron_llm_tpu.data.masked_lm import create_masked_lm_predictions
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(not helpers_available(),
+                                reason="native helpers unavailable")
+
+
+class _Tok:
+    """Tiny wordpiece-ish vocab: ids 0-4 special, 5+ words, every 7th id a
+    '##' continuation piece so whole-word grouping is exercised."""
+
+    def __init__(self, vocab_size=64):
+        self._inv = {}
+        for i in range(vocab_size):
+            if i == 0:
+                self._inv[i] = "[PAD]"
+            elif i == 1:
+                self._inv[i] = "[CLS]"
+            elif i == 2:
+                self._inv[i] = "[SEP]"
+            elif i == 3:
+                self._inv[i] = "[MASK]"
+            elif i % 7 == 0:
+                self._inv[i] = f"##piece{i}"
+            else:
+                self._inv[i] = f"word{i}"
+        self.vocab_size = vocab_size
+        self.cls, self.sep, self.mask, self.pad = 1, 2, 3, 0
+        self.bos_token_id, self.eos_token_id = 4, 5
+        self.additional_special_tokens_ids = list(range(54, 64))
+
+    @property
+    def inv_vocab(self):
+        return self._inv
+
+
+def _write_sentence_corpus(prefix, n_docs=6, rs=None):
+    rs = rs or np.random.RandomState(0)
+    builder = MMapIndexedDatasetBuilder(prefix + ".bin", np.int32)
+    for _ in range(n_docs):
+        for _ in range(rs.randint(2, 6)):  # sentences per doc
+            builder.add_item(rs.randint(6, 50, rs.randint(8, 24)))
+        builder.end_document()
+    builder.finalize(prefix + ".idx")
+    return make_dataset(prefix)
+
+
+def test_mapping_is_deterministic_and_valid(tmp_path):
+    ds = _write_sentence_corpus(str(tmp_path / "corp"))
+    m1 = build_mapping(ds.doc_idx, ds.sizes, 2, 10_000, 48, 0.1, 99)
+    m2 = build_mapping(ds.doc_idx, ds.sizes, 2, 10_000, 48, 0.1, 99)
+    np.testing.assert_array_equal(m1, m2)
+    assert len(m1) > 0
+    assert (m1[:, 0] < m1[:, 1]).all()
+    assert (m1[:, 2] >= 2).all() and (m1[:, 2] <= 48).all()
+
+
+def test_masked_lm_bert_statistics():
+    tok = _Tok()
+    rs = np.random.RandomState(3)
+    total = masked = mask_tok = 0
+    for trial in range(30):
+        tokens = [1] + list(rs.randint(6, 50, 60)) + [2]
+        out, pos, labels, boundary, spans = create_masked_lm_predictions(
+            tokens, list(tok.inv_vocab.keys()), tok.inv_vocab, 0.15,
+            tok.cls, tok.sep, tok.mask, 10, np.random.RandomState(trial),
+        )
+        # specials never masked
+        assert 0 not in pos and (len(tokens) - 1) not in pos
+        # output differs from input exactly at [MASK]/random positions
+        for p, lab in zip(pos, labels):
+            assert tokens[p] == lab
+        total += len(tokens)
+        masked += len(pos)
+        mask_tok += sum(1 for p in pos if out[p] == tok.mask)
+        # positions sorted, no duplicates
+        assert pos == sorted(pos) and len(set(pos)) == len(pos)
+    # ~15% masked, ~80% of those are [MASK]
+    assert 0.08 < masked / total < 0.2
+    assert 0.6 < mask_tok / max(masked, 1) < 0.95
+
+
+def test_masked_lm_whole_word_spans():
+    """Continuation pieces ('##') must be masked with their word."""
+    tok = _Tok()
+    # word at 8 followed by continuation 14 (## piece), etc.
+    tokens = [1, 8, 14, 9, 10, 21, 11, 2]  # 14,21 are ##pieces (id%7==0)
+    for seed in range(40):
+        out, pos, labels, boundary, spans = create_masked_lm_predictions(
+            tokens, list(tok.inv_vocab.keys()), tok.inv_vocab, 0.3,
+            tok.cls, tok.sep, tok.mask, 5, np.random.RandomState(seed),
+            max_ngrams=1,
+        )
+        # if the head of a split word (index 1) is masked, index 2 must be
+        # too (and vice versa)
+        assert (1 in pos) == (2 in pos), (seed, pos)
+
+
+def test_bert_dataset_fields(tmp_path):
+    from megatron_llm_tpu.data.bert_dataset import BertDataset
+
+    prefix = str(tmp_path / "bert_corp")
+    ds = _write_sentence_corpus(prefix)
+    tok = _Tok()
+    bert = BertDataset("train", ds, prefix, num_epochs=2,
+                       max_num_samples=100, masked_lm_prob=0.15,
+                       max_seq_length=64, short_seq_prob=0.1, seed=5,
+                       tokenizer=tok, binary_head=True)
+    assert len(bert) > 0
+    seen_random = set()
+    for i in range(min(len(bert), 20)):
+        s = bert[i]
+        assert s["text"].shape == (64,)
+        assert s["types"].shape == (64,)
+        assert s["labels"].shape == (64,)
+        assert s["padding_mask"].shape == (64,)
+        # loss mask marks exactly the positions with a label
+        np.testing.assert_array_equal(s["loss_mask"] == 1, s["labels"] >= 0)
+        # masked positions sit inside the non-pad region
+        assert (s["padding_mask"][s["loss_mask"] == 1] == 1).all()
+        # [CLS] first, tokentypes 0 then 1
+        assert s["text"][0] == tok.cls
+        seen_random.add(s["is_random"])
+        # reproducible
+        s2 = bert[i]
+        np.testing.assert_array_equal(s["text"], s2["text"])
+    assert seen_random == {0, 1}  # SOP flips both ways across samples
+
+
+def test_t5_dataset_sentinel_roundtrip(tmp_path):
+    from megatron_llm_tpu.data.t5_dataset import T5Dataset
+
+    prefix = str(tmp_path / "t5_corp")
+    ds = _write_sentence_corpus(prefix)
+    tok = _Tok()
+    t5 = T5Dataset("train", ds, prefix, num_epochs=2, max_num_samples=100,
+                   masked_lm_prob=0.15, max_seq_length=80,
+                   max_seq_length_dec=48, short_seq_prob=0.1, seed=5,
+                   tokenizer=tok)
+    assert len(t5) > 0
+    sentinels = set(tok.additional_special_tokens_ids)
+    for i in range(min(len(t5), 10)):
+        s = t5[i]
+        assert s["text_enc"].shape == (80,)
+        assert s["text_dec"].shape == (48,)
+        assert s["labels"].shape == (48,)
+        # decoder input starts with BOS; labels end the real region w/ EOS
+        assert s["text_dec"][0] == tok.bos_token_id
+        n_dec = int(s["dec_mask"].sum())
+        assert s["labels"][n_dec - 1] == tok.eos_token_id
+        # teacher forcing: labels are decoder input shifted left
+        np.testing.assert_array_equal(s["text_dec"][1:n_dec],
+                                      s["labels"][:n_dec - 1])
+        # sentinel structure: every sentinel in enc appears in labels
+        enc_sent = [t for t in s["text_enc"] if t in sentinels]
+        lab_sent = [t for t in s["labels"][:n_dec] if t in sentinels]
+        assert enc_sent == lab_sent
+        # reconstruction: interleaving enc text with label spans restores
+        # the original token stream
+        recon = []
+        lab = list(s["labels"][:n_dec - 1])
+        for t in s["text_enc"][: int(s["enc_mask"].sum())]:
+            if t in sentinels:
+                k = lab.index(t)
+                j = k + 1
+                while j < len(lab) and lab[j] not in sentinels:
+                    recon.append(lab[j])
+                    j += 1
+            else:
+                recon.append(int(t))
+        # rebuild the un-masked original from the dataset internals
+        start_idx, end_idx, seq_length = t5.samples_mapping[i]
+        orig = [t for j in range(start_idx, end_idx)
+                for t in np.asarray(ds[j])][:seq_length]
+        assert recon == [int(t) for t in orig]
+
+
+def test_ict_dataset(tmp_path):
+    from megatron_llm_tpu.data.ict_dataset import ICTDataset
+
+    prefix = str(tmp_path / "ict_corp")
+    ds = _write_sentence_corpus(prefix)
+    titles_prefix = str(tmp_path / "ict_titles")
+    rs = np.random.RandomState(9)
+    builder = MMapIndexedDatasetBuilder(titles_prefix + ".bin", np.int32)
+    for _ in range(len(ds.doc_idx) - 1):
+        builder.add_item(rs.randint(6, 50, 4))
+        builder.end_document()
+    builder.finalize(titles_prefix + ".idx")
+    titles = make_dataset(titles_prefix)
+
+    tok = _Tok()
+    ict = ICTDataset("train", ds, titles, prefix, num_epochs=1,
+                     max_num_samples=100, max_seq_length=96,
+                     query_in_block_prob=0.5, seed=3, tokenizer=tok)
+    assert len(ict) > 0
+    for i in range(min(len(ict), 10)):
+        s = ict[i]
+        assert s["query_tokens"].shape == (96,)
+        assert s["context_tokens"].shape == (96,)
+        assert s["query_tokens"][0] == tok.cls
+        assert s["context_tokens"][0] == tok.cls
+        nq = int(s["query_pad_mask"].sum())
+        assert s["query_tokens"][nq - 1] == tok.sep
+
+
+def test_pretrain_bert_cli_smoke(tmp_path):
+    """2 iterations of the full pretrain_bert CLI on a toy corpus."""
+    prefix = str(tmp_path / "smoke_corp")
+    _write_sentence_corpus(prefix, n_docs=20)
+    vocab_file = tmp_path / "vocab.txt"
+    words = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + [
+        f"word{i}" for i in range(60)
+    ]
+    vocab_file.write_text("\n".join(words) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "pretrain_bert.py"),
+         "--model_name", "bert",
+         "--num_layers", "2", "--hidden_size", "64",
+         "--num_attention_heads", "4", "--ffn_hidden_size", "128",
+         "--seq_length", "48", "--max_position_embeddings", "48",
+         "--micro_batch_size", "2", "--global_batch_size", "2",
+         "--data_parallel_size", "1",
+         "--train_iters", "2", "--lr", "1e-4", "--log_interval", "1",
+         "--data_path", prefix, "--split", "100,0,0",
+         "--tokenizer_type", "BertWordPieceLowerCase",
+         "--vocab_file", str(vocab_file)],
+        capture_output=True, text=True, env=env, timeout=600, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert "lm loss" in proc.stdout
+
+
+def test_pretrain_t5_cli_smoke(tmp_path):
+    """2 iterations of the full pretrain_t5 CLI on a toy corpus."""
+    prefix = str(tmp_path / "smoke_corp_t5")
+    _write_sentence_corpus(prefix, n_docs=20)
+    vocab_file = tmp_path / "vocab.txt"
+    words = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + [
+        f"word{i}" for i in range(60)
+    ]
+    vocab_file.write_text("\n".join(words) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "pretrain_t5.py"),
+         "--model_name", "t5",
+         "--num_layers", "2", "--hidden_size", "64",
+         "--num_attention_heads", "4", "--ffn_hidden_size", "128",
+         "--seq_length", "48", "--max_position_embeddings", "48",
+         "--decoder_seq_length", "48", "--vocab_extra_ids", "20",
+         "--micro_batch_size", "2", "--global_batch_size", "2",
+         "--data_parallel_size", "1",
+         "--train_iters", "2", "--lr", "1e-4", "--log_interval", "1",
+         "--data_path", prefix, "--split", "100,0,0",
+         "--tokenizer_type", "BertWordPieceLowerCase",
+         "--vocab_file", str(vocab_file)],
+        capture_output=True, text=True, env=env, timeout=600, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert "lm loss" in proc.stdout
