@@ -257,6 +257,34 @@ def test_pretrain_bert_cli_smoke(tmp_path):
     assert "lm loss" in proc.stdout
 
 
+def test_preprocess_split_sentences(tmp_path):
+    """--split_sentences writes one indexed item per sentence with doc
+    boundaries per input line (the layout BERT/T5/ICT maps consume)."""
+    import json
+
+    vocab_file = tmp_path / "v.txt"
+    vocab_file.write_text("\n".join(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "hello", "world",
+         "this", "is", "fine", "ok", ".", "!", "?"]) + "\n")
+    corpus = tmp_path / "c.jsonl"
+    with open(corpus, "w") as f:
+        f.write(json.dumps({"text": "Hello world. This is fine! Ok?"}) + "\n")
+        f.write(json.dumps({"text": "World hello ok. Fine this is."}) + "\n")
+    out_prefix = str(tmp_path / "out")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "preprocess_data.py"),
+         "--input", str(corpus), "--output_prefix", out_prefix,
+         "--tokenizer_type", "BertWordPieceLowerCase",
+         "--vocab_file", str(vocab_file), "--split_sentences"],
+        capture_output=True, text=True, timeout=300, cwd=_REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    ds = make_dataset(out_prefix + "_text_document")
+    assert list(ds.doc_idx) == [0, 3, 5]  # 3 + 2 sentences
+    np.testing.assert_array_equal(np.asarray(ds[0]), [5, 6, 11])  # hello world .
+
+
 def test_pretrain_t5_cli_smoke(tmp_path):
     """2 iterations of the full pretrain_t5 CLI on a toy corpus."""
     prefix = str(tmp_path / "smoke_corp_t5")

@@ -33,10 +33,39 @@ from megatron_llm_tpu.tokenizer import build_tokenizer
 
 _TOKENIZER = None
 _ARGS = None
+_SPLITTER = None
+
+
+def _build_splitter():
+    """Sentence splitter for --split_sentences (BERT/T5/ICT corpora need
+    sentence-level documents, ref: preprocess_data.py:85-106 uses nltk
+    punkt). nltk when importable, else a punctuation-boundary regex."""
+    try:
+        import nltk
+
+        try:
+            nltk.sent_tokenize("probe. works.")
+            print(" > sentence splitter: nltk punkt", flush=True)
+            return nltk.sent_tokenize
+        except LookupError:
+            pass
+    except ImportError:
+        pass
+    print(" > sentence splitter: regex fallback (nltk/punkt unavailable) — "
+          "boundaries WILL differ from nltk-built corpora; do not mix",
+          flush=True)
+    import re
+
+    boundary = re.compile(r"(?<=[.!?])\s+(?=[A-Z0-9\"'(])")
+
+    def split(text):
+        return [s for s in boundary.split(text) if s.strip()]
+
+    return split
 
 
 def _init_worker(args):
-    global _TOKENIZER, _ARGS
+    global _TOKENIZER, _ARGS, _SPLITTER
     _ARGS = args
     _TOKENIZER = build_tokenizer(
         args.tokenizer_type,
@@ -46,10 +75,15 @@ def _init_worker(args):
         make_vocab_size_divisible_by=args.make_vocab_size_divisible_by,
         null_vocab_size=args.null_vocab_size,
     )
+    if args.split_sentences:
+        _SPLITTER = _build_splitter()
 
 
 def _encode(line: str):
-    """ref: Encoder.encode (preprocess_data.py:42-80)."""
+    """ref: Encoder.encode (preprocess_data.py:42-80). With
+    --split_sentences each document becomes a LIST of per-sentence id
+    lists (one indexed-dataset item per sentence, doc boundary per line),
+    the layout the BERT/T5/ICT sample maps consume."""
     line = line.strip()
     if not line:
         return None, 0
@@ -57,10 +91,19 @@ def _encode(line: str):
     out = {}
     for key in _ARGS.json_keys:
         text = data[key]
-        ids = _TOKENIZER.tokenize(text)
-        if _ARGS.append_eod and len(ids) > 0:
-            ids.append(_TOKENIZER.eod)
-        out[key] = ids
+        if _ARGS.split_sentences:
+            sent_ids = [
+                ids for s in _SPLITTER(text)
+                if (ids := _TOKENIZER.tokenize(s))
+            ]
+            if _ARGS.append_eod and sent_ids:
+                sent_ids[-1].append(_TOKENIZER.eod)
+            out[key] = sent_ids
+        else:
+            ids = _TOKENIZER.tokenize(text)
+            if _ARGS.append_eod and len(ids) > 0:
+                ids.append(_TOKENIZER.eod)
+            out[key] = [ids] if ids else []
     return out, len(line)
 
 
@@ -75,6 +118,8 @@ def get_args(argv=None):
     g.add_argument("--merges_file", type=str, default=None)
     g.add_argument("--tokenizer_model", type=str, default=None)
     g.add_argument("--append_eod", action="store_true")
+    g.add_argument("--split_sentences", action="store_true",
+                   help="one indexed item per sentence (BERT/T5/ICT)")
     g.add_argument("--make_vocab_size_divisible_by", type=int, default=128)
     g.add_argument("--null_vocab_size", type=int, default=None)
     g = p.add_argument_group("output data")
@@ -123,10 +168,11 @@ def main(argv=None):
         if doc is None:
             continue
         total_bytes += nbytes
-        for key, ids in doc.items():
-            if len(ids) == 0:
+        for key, sentences in doc.items():
+            if len(sentences) == 0:
                 continue
-            builders[key].add_item(np.asarray(ids))
+            for ids in sentences:
+                builders[key].add_item(np.asarray(ids))
             builders[key].end_document()
         n_docs += 1
         if n_docs % args.log_interval == 0:
