@@ -48,6 +48,9 @@ def main(argv=None):
         )
         vocab_size = tokenizer.vocab_size
 
+    from megatron_llm_tpu.parallel.mesh import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
     mcfg, pcfg, tcfg, dargs = args_to_configs(args, vocab_size)
 
     print(f"devices: {len(jax.devices())} ({jax.default_backend()}); "

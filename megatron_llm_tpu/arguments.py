@@ -166,6 +166,10 @@ def build_base_parser() -> argparse.ArgumentParser:
     g.add_argument("--wandb_logger", action="store_true")
     g.add_argument("--log_params_norm", action="store_true")
     g.add_argument("--log_num_zeros_in_grad", action="store_true")
+    g.add_argument("--profile", action="store_true")
+    g.add_argument("--profile_step_start", type=int, default=10)
+    g.add_argument("--profile_step_end", type=int, default=12)
+    g.add_argument("--profile_dir", type=str, default=None)
 
     return p
 
@@ -305,6 +309,10 @@ def args_to_configs(args, padded_vocab_size: int):
         wandb_logger=args.wandb_logger,
         log_params_norm=args.log_params_norm,
         log_num_zeros_in_grad=args.log_num_zeros_in_grad,
+        profile=args.profile,
+        profile_step_start=args.profile_step_start,
+        profile_step_end=args.profile_step_end,
+        profile_dir=args.profile_dir,
         seed=args.seed,
     )
 

@@ -259,6 +259,12 @@ class TrainConfig:
     # ref: --log-params-norm / --log-num-zeros-in-grad (arguments.py:481-487)
     log_params_norm: bool = False
     log_num_zeros_in_grad: bool = False
+    # ref: --profile/--profile-step-start/--profile-step-end
+    # (arguments.py:531-541, nsys there; jax.profiler trace here)
+    profile: bool = False
+    profile_step_start: int = 10
+    profile_step_end: int = 12
+    profile_dir: Optional[str] = None
 
     seed: int = 1234
 

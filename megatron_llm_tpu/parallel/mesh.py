@@ -30,6 +30,39 @@ AXIS_NAMES = (DATA_AXIS, STAGE_AXIS, MODEL_AXIS)
 _CONTEXT: Optional["ParallelContext"] = None
 
 
+def maybe_initialize_distributed() -> int:
+    """Multi-host bring-up — the analogue of the reference's
+    torch.distributed.init_process_group + NCCL rendezvous
+    (ref: initialize.py:180-217).
+
+    On TPU pods the runtime publishes coordinator/task env vars and
+    `jax.distributed.initialize()` needs no arguments; after it returns,
+    `jax.devices()` spans every host and the (data, stage, model) mesh
+    built below automatically lays DCN-crossing axes outermost. No-op on
+    single-process runs. Returns the process count.
+
+    MUST run before ANY other jax call (jax.devices()/process_count()
+    initialize the local-only backend and make the rendezvous impossible)
+    — every entry point calls this first, before args_to_configs touches
+    jax.devices(). Real rendezvous failures propagate; only
+    double-initialization is tolerated.
+    """
+    import os
+
+    multiproc_env = any(
+        v in os.environ
+        for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                  "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if multiproc_env:
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:
+            if "already" not in str(e):
+                raise
+    return jax.process_count()
+
+
 def build_mesh(
     dp: int = 1,
     pp: int = 1,

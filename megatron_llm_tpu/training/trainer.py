@@ -120,6 +120,8 @@ class Trainer:
         self.reset_attention_mask = reset_attention_mask
         self.eod_mask_loss = eod_mask_loss
         self.timers = Timers()
+        self._n_params = 0  # set in setup(); enables the TFLOP/s log field
+        self._trace_active = False
         self.ctx = get_context()
         self._eval_step_fn = None
 
@@ -214,6 +216,8 @@ class Trainer:
             opt_state = init_optimizer_state(params, self.tcfg)
         self.timers("model-and-optimizer-setup").stop()
 
+        self._n_params = sum(int(np.prod(p.shape))
+                             for p in jax.tree.leaves(params))
         state = TrainState(params=params, opt_state=opt_state)
         if self.tcfg.load:
             loaded = load_checkpoint(
@@ -358,6 +362,14 @@ class Trainer:
         if "params_norm" in stats:
             line += f"params norm: {float(stats['params_norm']):.3f} | "
         line += f"skipped iterations: {int(stats['skipped'])}"
+        # throughput + achieved model-FLOP/s (the reference logs
+        # elapsed-per-iteration only; TFLOP/s makes MFU one division away)
+        if self._n_params:
+            tok_s = stats["batch_size"] * self.cfg.seq_length / max(elapsed,
+                                                                    1e-9)
+            tflops = tok_s * 6 * self._n_params / 1e12
+            line += (f" | tokens/sec: {tok_s:.1f} | "
+                     f"model TFLOP/s: {tflops:.2f}")
         print(line, flush=True)
         # timer dump at the log cadence; only per-iteration timers get the
         # log_interval normalizer (one-shot timers like setup/save would be
@@ -416,6 +428,15 @@ class Trainer:
             step_rng = None
             if dropout_rng is not None:
                 step_rng = jax.random.fold_in(dropout_rng, state.iteration)
+            # device-trace window (ref: --profile nsys window,
+            # training.py:687-703; here jax.profiler -> tensorboard)
+            if (tcfg.profile and not self._trace_active
+                    and state.iteration >= tcfg.profile_step_start
+                    and state.iteration < tcfg.profile_step_end):
+                jax.profiler.start_trace(
+                    tcfg.profile_dir or tcfg.tensorboard_dir or "./profile"
+                )
+                self._trace_active = True
             t0 = time.time()
             # the whole fused fwd+bwd+optimizer dispatch — the reference's
             # forward-backward/optimizer timer pair collapses into one
@@ -426,6 +447,9 @@ class Trainer:
             self.timers("train-step").stop()
             stats["loss"] = loss_val
             elapsed = time.time() - t0
+            if self._trace_active and state.iteration >= tcfg.profile_step_end:
+                jax.profiler.stop_trace()
+                self._trace_active = False
 
             if state.iteration % tcfg.log_interval == 0:
                 self._training_log(state, stats, elapsed)
@@ -456,6 +480,10 @@ class Trainer:
             if tcfg.exit_interval and state.iteration % tcfg.exit_interval == 0:
                 print(f"exiting at iteration {state.iteration}", flush=True)
                 break
+        if self._trace_active:
+            # early exit inside the profile window: flush the trace
+            jax.profiler.stop_trace()
+            self._trace_active = False
         return state
 
 

@@ -52,6 +52,11 @@ def main(argv=None):
                    action="store_true")
     args = p.parse_args(argv)
 
+    from megatron_llm_tpu.parallel.mesh import (
+        maybe_initialize_distributed,
+    )
+
+    maybe_initialize_distributed()  # before any jax.devices() use
     tokenizer = build_tokenizer(
         args.tokenizer_type or "BertWordPieceLowerCase",
         vocab_file=args.vocab_file,
