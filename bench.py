@@ -46,8 +46,9 @@ def main():
     # live activations need 23G at mbs 8 / seq 1024 (measured), and the
     # chip tops out at mbs 2 with ~13% lower FLOP/s. Block-remat (fewer
     # rematted layers) measured flat — the step is compute-bound, not
-    # recompute-bound.
-    mbs = 8 if seq == 1024 else 2
+    # recompute-bound. seq 4096 fits mbs 6 now that the head+CE is
+    # sequence-chunked (no full fp32 logits buffer).
+    mbs = 8 if seq == 1024 else 6
 
     cfg = ModelConfig(
         num_layers=12,

@@ -1,0 +1,116 @@
+"""Classification + multiple-choice heads on the BERT encoder.
+
+Parity targets: ref megatron/model/classification.py:17-105 (pooled CLS
+-> dropout -> num_classes linear) and multiple_choice.py (same with a
+1-dim head over flattened (b * num_choices, s) inputs, reshaped back to
+(b, num_choices)). Both reuse BertModel.encode + the pooler; the
+downstream GLUE/RACE finetuning in tasks/ drives them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import ModelConfig
+from megatron_llm_tpu.models.bert import BertModel
+from megatron_llm_tpu.parallel.cross_entropy import cross_entropy
+
+
+def _normal(key, shape, std, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+class Classification:
+    """ref: Classification classification.py:17-105."""
+
+    def __init__(self, cfg: ModelConfig, num_classes: int):
+        self.cfg = cfg
+        self.num_classes = num_classes
+        self.bert = BertModel(cfg)
+
+    def init(self, rng: jax.Array) -> dict:
+        cfg = self.cfg
+        params = self.bert.init(rng)
+        params.pop("lm_head", None)
+        params.pop("binary_head", None)
+        k1, k2 = jax.random.split(jax.random.fold_in(rng, 31))
+        h = cfg.hidden_size
+        if "pooler" not in params:
+            params["pooler"] = {
+                "w": _normal(k1, (h, h), cfg.init_method_std,
+                             cfg.params_dtype),
+                "b": jnp.zeros((h,), cfg.params_dtype),
+            }
+        params["classification_head"] = {
+            "w": _normal(k2, (h, self.num_classes), cfg.init_method_std,
+                         cfg.params_dtype),
+            "b": jnp.zeros((self.num_classes,), cfg.params_dtype),
+        }
+        return params
+
+    def forward(self, params, tokens, attention_mask=None,
+                tokentype_ids=None, dropout_rng=None,
+                deterministic: bool = True) -> jnp.ndarray:
+        """(b, s) -> (b, num_classes) logits
+        (ref: Classification.forward :58-80)."""
+        cfg = self.cfg
+        hidden = self.bert.encode(params, tokens, attention_mask,
+                                  tokentype_ids, dropout_rng, deterministic)
+        dt = cfg.compute_dtype
+        pooled = jnp.tanh(
+            hidden[:, 0] @ params["pooler"]["w"].astype(dt)
+            + params["pooler"]["b"].astype(dt)
+        )
+        if not deterministic and cfg.hidden_dropout > 0 and dropout_rng is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(dropout_rng, 7),
+                1.0 - cfg.hidden_dropout, pooled.shape,
+            )
+            pooled = pooled * keep / (1.0 - cfg.hidden_dropout)
+        head = params["classification_head"]
+        return pooled @ head["w"].astype(dt) + head["b"].astype(dt)
+
+    def loss(self, params, tokens, labels, attention_mask=None,
+             tokentype_ids=None, dropout_rng=None,
+             deterministic: bool = True) -> jnp.ndarray:
+        """Mean CE over classes (ref: cross_entropy_loss_func
+        tasks/finetune_utils.py:36-46)."""
+        logits = self.forward(params, tokens, attention_mask, tokentype_ids,
+                              dropout_rng, deterministic)
+        return jnp.mean(cross_entropy(logits.astype(jnp.float32), labels))
+
+
+class MultipleChoice:
+    """ref: MultipleChoice multiple_choice.py — a 1-logit head scored per
+    choice; inputs carry a leading choices axis."""
+
+    def __init__(self, cfg: ModelConfig, num_choices: int = 4):
+        self.cfg = cfg
+        self.num_choices = num_choices
+        self._cls = Classification(cfg, num_classes=1)
+
+    def init(self, rng: jax.Array) -> dict:
+        return self._cls.init(rng)
+
+    def forward(self, params, tokens, attention_mask=None,
+                tokentype_ids=None, dropout_rng=None,
+                deterministic: bool = True) -> jnp.ndarray:
+        """tokens (b, num_choices, s) -> (b, num_choices) logits."""
+        b, c, s = tokens.shape
+        flat = lambda x: (None if x is None  # noqa: E731
+                          else x.reshape(b * c, *x.shape[2:]))
+        logits = self._cls.forward(
+            params, tokens.reshape(b * c, s), flat(attention_mask),
+            flat(tokentype_ids), dropout_rng, deterministic,
+        )
+        return logits.reshape(b, c)
+
+    def loss(self, params, tokens, labels, attention_mask=None,
+             tokentype_ids=None, dropout_rng=None,
+             deterministic: bool = True) -> jnp.ndarray:
+        logits = self.forward(params, tokens, attention_mask, tokentype_ids,
+                              dropout_rng, deterministic)
+        return jnp.mean(cross_entropy(logits.astype(jnp.float32), labels))

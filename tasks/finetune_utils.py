@@ -1,0 +1,141 @@
+"""Epoch-based classification finetuning shared by GLUE and RACE.
+
+Parity target: ref tasks/finetune_utils.py:141-337 — epoch loop over a
+shuffled train set, LR warmup+decay over total steps, per-epoch
+validation accuracy, best-checkpoint save. TPU-first: one jitted
+(loss+grad+Adam) step and one jitted accuracy step; the host only stacks
+numpy batches.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.optimizer import init_optimizer_state
+from megatron_llm_tpu.optimizer.optimizer import optimizer_step
+from megatron_llm_tpu.optimizer.scheduler import OptimizerParamScheduler
+
+
+def _stack_batch(samples):
+    # RACE samples already carry a (num_choices, s) leading axis; stacking
+    # is identical for both task shapes
+    return {
+        "tokens": np.stack([s["text"] for s in samples]).astype(np.int32),
+        "attention_mask": np.stack(
+            [s["padding_mask"] for s in samples]
+        ).astype(np.int32),
+        "tokentype_ids": np.stack([s["types"] for s in samples]).astype(
+            np.int32
+        ),
+        "labels": np.asarray([s["label"] for s in samples], np.int32),
+    }
+
+
+def _batches(dataset, batch_size, rng=None, drop_last=True):
+    order = np.arange(len(dataset))
+    if rng is not None:
+        rng.shuffle(order)
+    end = (len(order) // batch_size * batch_size if drop_last
+           else len(order))
+    for i in range(0, end, batch_size):
+        idxs = order[i:i + batch_size]
+        if len(idxs) < batch_size and drop_last:
+            break
+        yield [dataset[int(j)] for j in idxs]
+
+
+def accuracy(model, params, dataset, batch_size: int) -> float:
+    """ref: calculate_correct_answers (eval_utils.py) — exact-match
+    accuracy over the whole set, jitted argmax per batch. The jitted fn
+    is cached on the model object so repeated calls (one per validation
+    epoch) reuse one compilation."""
+    correct = model.__dict__.get("_accuracy_step")
+    if correct is None:
+        @jax.jit
+        def correct(params, batch):
+            logits = model.forward(
+                params, batch["tokens"], batch["attention_mask"],
+                batch["tokentype_ids"],
+            )
+            return jnp.sum(jnp.argmax(logits, -1) == batch["labels"])
+
+        model.__dict__["_accuracy_step"] = correct
+
+    total = n = 0
+    for samples in _batches(dataset, batch_size, drop_last=False):
+        batch = {k: jnp.asarray(v)
+                 for k, v in _stack_batch(samples).items()}
+        total += int(correct(params, batch))
+        n += len(samples)
+    return total / max(n, 1)
+
+
+def finetune(model, params, train_ds, valid_ds, *, epochs: int,
+             batch_size: int, lr: float, weight_decay: float = 0.01,
+             warmup_fraction: float = 0.065, seed: int = 1234,
+             tcfg=None, log_interval: int = 50):
+    """Run the finetune loop; returns (params, best_valid_accuracy)
+    (ref: finetune_utils.finetune :241-337)."""
+    from megatron_llm_tpu.config import TrainConfig
+
+    tcfg = tcfg or TrainConfig(micro_batch_size=batch_size,
+                               global_batch_size=batch_size, lr=lr,
+                               weight_decay=weight_decay)
+    opt_state = init_optimizer_state(params, tcfg)
+    steps_per_epoch = len(train_ds) // batch_size
+    total_steps = max(1, epochs * steps_per_epoch)
+    sched = OptimizerParamScheduler(
+        max_lr=lr, min_lr=0.0,
+        lr_warmup_steps=int(warmup_fraction * total_steps),
+        lr_decay_steps=total_steps, lr_decay_style="linear",
+        start_wd=weight_decay, end_wd=weight_decay, wd_incr_steps=total_steps,
+        wd_incr_style="constant",
+    )
+
+    @jax.jit
+    def step(params, opt_state, batch, lr_now, dropout_rng):
+        def loss_fn(p):
+            return model.loss(
+                p, batch["tokens"], batch["labels"],
+                attention_mask=batch["attention_mask"],
+                tokentype_ids=batch["tokentype_ids"],
+                dropout_rng=dropout_rng,
+                deterministic=False,
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state, stats = optimizer_step(
+            params, grads, opt_state, tcfg, lr_now,
+            weight_decay=jnp.float32(weight_decay),
+        )
+        stats["loss"] = loss
+        return params, opt_state, stats
+
+    rng = np.random.RandomState(seed)
+    dropout_key = jax.random.key(seed + 1)
+    best_acc, it = 0.0, 0
+    for epoch in range(epochs):
+        t0 = time.time()
+        for samples in _batches(train_ds, batch_size, rng=rng):
+            batch = {k: jnp.asarray(v)
+                     for k, v in _stack_batch(samples).items()}
+            params, opt_state, stats = step(
+                params, opt_state, batch, jnp.float32(sched.get_lr()),
+                jax.random.fold_in(dropout_key, it),
+            )
+            sched.step()
+            it += 1
+            if it % log_interval == 0:
+                print(f"epoch {epoch} iter {it}/{total_steps} | "
+                      f"loss {float(stats['loss']):.4f} | "
+                      f"lr {sched.get_lr():.3E}", flush=True)
+        if valid_ds is not None and len(valid_ds):
+            acc = accuracy(model, params, valid_ds, batch_size)
+            best_acc = max(best_acc, acc)
+            print(f"epoch {epoch} done in {time.time()-t0:.1f}s | "
+                  f"validation accuracy: {acc:.4f}", flush=True)
+    return params, best_acc

@@ -7,10 +7,16 @@
 
   python tasks/main.py --task LAMBADA --valid_data lambada.jsonl ...
 
-Without --load the model evaluates at random init (useful for smoke runs
-only). The retriever/Race/MNLI finetune family of the reference is not
-implemented (matching its own 'not supported' carve-outs for non-GPT
-models, main.py:80-100).
+Classification finetuning (BERT encoder + task head, epoch loop with
+per-epoch validation accuracy):
+
+  python tasks/main.py --task MNLI --train_data train.tsv \\
+      --valid_data dev_matched.tsv --pretrained_checkpoint ckpts/bert \\
+      --epochs 3 --lr 5e-5 ...   (QQP and RACE likewise)
+
+Without --load / --pretrained_checkpoint the model runs at random init
+(useful for smoke runs only). The REALM/retriever finetune family is not
+implemented.
 """
 
 from __future__ import annotations
@@ -26,15 +32,112 @@ import jax
 
 def get_tasks_args(parser):
     """ref: get_tasks_args (tasks/main.py:14-72), minus the retriever/faiss
-    group that belongs to the unimplemented ICT stack."""
+    group that belongs to the REALM stack."""
     g = parser.add_argument_group("tasks")
     g.add_argument("--task", type=str, required=True,
-                   choices=["WIKITEXT103", "LAMBADA"])
+                   choices=["WIKITEXT103", "LAMBADA", "MNLI", "QQP", "RACE"])
+    g.add_argument("--train_data", nargs="+", default=None)
     g.add_argument("--valid_data", nargs="*", default=None)
     g.add_argument("--overlapping_eval", type=int, default=32)
     g.add_argument("--strict_lambada", action="store_true")
     g.add_argument("--eval_micro_batch_size", type=int, default=None)
+    g.add_argument("--epochs", type=int, default=3)
+    g.add_argument("--pretrained_checkpoint", type=str, default=None)
     return parser
+
+
+def _finetune_main(args):
+    """Classification finetuning dispatch (ref: tasks/glue/finetune.py +
+    tasks/race/finetune.py through finetune_utils.finetune)."""
+    import dataclasses
+
+    from megatron_llm_tpu.arguments import args_to_configs
+    from megatron_llm_tpu.parallel import initialize_parallel
+    from megatron_llm_tpu.tokenizer import build_tokenizer
+    from megatron_llm_tpu.training.checkpointing import load_checkpoint
+
+    from megatron_llm_tpu.models.classification import (
+        Classification,
+        MultipleChoice,
+    )
+    from tasks.finetune_utils import accuracy, finetune
+
+    tokenizer = build_tokenizer(
+        args.tokenizer_type or "BertWordPieceLowerCase",
+        vocab_file=args.vocab_file,
+        make_vocab_size_divisible_by=args.make_vocab_size_divisible_by,
+        tensor_parallel_size=args.tensor_model_parallel_size,
+    )
+    args.model_name = "bert"
+    mcfg, pcfg, tcfg, _ = args_to_configs(args, tokenizer.vocab_size)
+    mcfg = dataclasses.replace(mcfg, add_binary_head=False)
+    initialize_parallel(dp=pcfg.data_parallel_size, pp=1,
+                        tp=pcfg.tensor_parallel_size,
+                        sequence_parallel=pcfg.sequence_parallel)
+
+    if args.task == "MNLI":
+        from tasks.glue.mnli import MNLIDataset as DS
+
+        model = Classification(mcfg, num_classes=3)
+    elif args.task == "QQP":
+        from tasks.glue.qqp import QQPDataset as DS
+
+        model = Classification(mcfg, num_classes=2)
+    else:  # RACE
+        from tasks.race.data import RaceDataset as DS
+
+        model = MultipleChoice(mcfg)
+
+    params = model.init(jax.random.key(tcfg.seed))
+    if args.pretrained_checkpoint:
+        # Load ENCODER weights from a BERT pretraining checkpoint; heads
+        # stay freshly initialized (the reference's strict=False load,
+        # finetune_utils.py:291-312). Orbax restores against the exact
+        # saved tree, so restore into a pretraining-shaped template and
+        # merge the overlapping subtrees.
+        from megatron_llm_tpu.models import BertModel as _Bert
+
+        loaded = None
+        for binary in (True, False):
+            tmpl_cfg = dataclasses.replace(mcfg, add_binary_head=binary)
+            tmpl = jax.eval_shape(
+                _Bert(tmpl_cfg).init, jax.random.key(0)
+            )
+            try:
+                restored = load_checkpoint(
+                    args.pretrained_checkpoint, tmpl, no_load_optim=True,
+                    finetune=True,
+                )
+            except Exception:
+                continue
+            if restored is not None:
+                loaded = restored[0]
+                break
+        assert loaded is not None, (
+            f"could not restore encoder weights from "
+            f"{args.pretrained_checkpoint}"
+        )
+        for key in params:
+            if key in loaded:
+                params[key] = loaded[key]
+        print(" > loaded pretrained encoder weights "
+              f"({sorted(set(params) & set(loaded))})", flush=True)
+
+    assert args.train_data, f"--train_data is required for {args.task}"
+    train_ds = DS("training", args.train_data, tokenizer, mcfg.seq_length)
+    valid_ds = (DS("validation", args.valid_data, tokenizer,
+                   mcfg.seq_length) if args.valid_data else None)
+    params, best = finetune(
+        model, params, train_ds, valid_ds, epochs=args.epochs,
+        batch_size=args.micro_batch_size, lr=tcfg.lr,
+        weight_decay=tcfg.weight_decay, seed=tcfg.seed,
+        warmup_fraction=args.lr_warmup_fraction or 0.065,
+        tcfg=tcfg, log_interval=args.log_interval,
+    )
+    if valid_ds is not None:
+        final = accuracy(model, params, valid_ds, args.micro_batch_size)
+        print(f"final validation accuracy: {final:.4f} (best {best:.4f})",
+              flush=True)
 
 
 def main(argv=None):
@@ -49,6 +152,10 @@ def main(argv=None):
 
     parser = get_tasks_args(build_base_parser())
     args = parser.parse_args(argv)
+    if args.task in ("MNLI", "QQP", "RACE"):
+        _finetune_main(args)
+        print("done :-)")
+        return
     assert args.valid_data and len(args.valid_data) == 1, \
         "--valid_data takes exactly one path"
 

@@ -1,0 +1,192 @@
+"""Classification/multiple-choice finetuning: models, readers, loop, CLI.
+
+Ref analogues: model/classification.py + multiple_choice.py heads,
+tasks/glue readers' column contracts, tasks/finetune_utils' epoch loop.
+The learning test trains a tiny classifier on a linearly-separable toy
+problem and requires near-perfect accuracy — the whole loop (batching,
+masking, scheduler, optimizer) must work for that to happen.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import bert_config
+from megatron_llm_tpu.models.classification import (
+    Classification,
+    MultipleChoice,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**over):
+    return bert_config(num_layers=2, hidden_size=64, num_attention_heads=4,
+                       seq_length=32, vocab_size=100, ffn_hidden_size=128,
+                       compute_dtype=jnp.float32, add_binary_head=False,
+                       **over)
+
+
+def test_classification_shapes_and_grads():
+    model = Classification(_cfg(), num_classes=3)
+    params = model.init(jax.random.key(0))
+    toks = jnp.arange(64, dtype=jnp.int32).reshape(2, 32) % 100
+    logits = model.forward(params, toks)
+    assert logits.shape == (2, 3)
+    loss, grads = jax.value_and_grad(
+        lambda p: model.loss(p, toks, jnp.asarray([0, 2]))
+    )(params)
+    assert np.isfinite(float(loss))
+    assert any(float(jnp.abs(x).max()) > 0
+               for x in jax.tree.leaves(grads["classification_head"]))
+
+
+def test_multiple_choice_shapes():
+    model = MultipleChoice(_cfg())
+    params = model.init(jax.random.key(1))
+    toks = jnp.arange(256, dtype=jnp.int32).reshape(2, 4, 32) % 100
+    logits = model.forward(params, toks)
+    assert logits.shape == (2, 4)
+    loss = model.loss(params, toks, jnp.asarray([1, 3]))
+    assert np.isfinite(float(loss))
+
+
+class _Sep:
+    """Toy dataset: label decided by the token right after [CLS] (7 vs 8)
+    — trivially separable, so the loop must reach ~1.0 within a few
+    epochs for the plumbing (batching, masks, scheduler, optimizer) to be
+    considered working."""
+
+    def __init__(self, n, seed):
+        rs = np.random.RandomState(seed)
+        self.samples = []
+        for i in range(n):
+            label = int(rs.rand() < 0.5)
+            toks = rs.randint(10, 90, 30)
+            toks[0] = 7 if label else 8
+            ids = [2] + list(toks) + [3]  # [CLS] ... [SEP]
+            self.samples.append({
+                "text": np.array(ids[:32], np.int64),
+                "types": np.zeros(32, np.int64),
+                "padding_mask": np.ones(32, np.int64),
+                "label": label,
+                "uid": i,
+            })
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+def test_finetune_loop_learns_separable_task():
+    from tasks.finetune_utils import accuracy, finetune
+
+    model = Classification(_cfg(), num_classes=2)
+    params = model.init(jax.random.key(2))
+    train, valid = _Sep(256, 0), _Sep(64, 1)
+    params, best = finetune(model, params, train, valid, epochs=4,
+                            batch_size=16, lr=1e-3, log_interval=1000)
+    acc = accuracy(model, params, valid, 16)
+    assert acc > 0.95, acc
+
+
+def test_glue_readers(tmp_path):
+    from tasks.glue.mnli import MNLIDataset
+    from tasks.glue.qqp import QQPDataset
+
+    class Tok:
+        cls, sep, pad = 2, 3, 0
+
+        def tokenize(self, text):
+            return [hash(w) % 50 + 10 for w in text.split()]
+
+    mnli = tmp_path / "mnli.tsv"
+    mnli.write_text(
+        "index\tc1\tc2\tc3\tc4\tc5\tc6\tc7\tsentence1\tsentence2\tx\tgold_label\n"
+        "0\t-\t-\t-\t-\t-\t-\t-\tthe cat sat\tthe cat is sitting\tx\tentailment\n"
+        "1\t-\t-\t-\t-\t-\t-\t-\tthe dog ran\tthe dog slept\tx\tcontradiction\n"
+    )
+    ds = MNLIDataset("dev", [str(mnli)], Tok(), 32)
+    assert len(ds) == 2
+    s = ds[0]
+    assert s["label"] == 1 and s["text"].shape == (32,)
+    assert s["text"][0] == 2  # [CLS]
+    # types flip to 1 after the first [SEP]
+    sep_pos = int(np.argmax(s["text"] == 3))
+    assert s["types"][sep_pos + 1] == 1
+
+    qqp = tmp_path / "qqp.tsv"
+    qqp.write_text(
+        "id\tqid1\tqid2\tquestion1\tquestion2\tis_duplicate\n"
+        "0\ta\tb\thow to cook rice\thow do i cook rice\t1\n"
+        "1\ta\tb\twhat is jax\twho won the game\t0\n"
+        "2\tbad row\n"
+    )
+    ds = QQPDataset("dev", [str(qqp)], Tok(), 32)
+    assert len(ds) == 2
+    assert ds[0]["label"] == 1 and ds[1]["label"] == 0
+
+
+def test_race_reader(tmp_path):
+    from tasks.race.data import RaceDataset
+
+    class Tok:
+        cls, sep, pad = 2, 3, 0
+
+        def tokenize(self, text):
+            return [hash(w) % 50 + 10 for w in text.split()]
+
+    f = tmp_path / "q1.txt"
+    f.write_text(json.dumps({
+        "article": "the quick brown fox jumps over the lazy dog",
+        "questions": ["what jumps"],
+        "options": [["fox", "dog", "cat", "bird"]],
+        "answers": ["A"],
+    }))
+    ds = RaceDataset("train", [str(tmp_path)], Tok(), 32)
+    assert len(ds) == 1
+    s = ds[0]
+    assert s["text"].shape == (4, 32)
+    assert s["label"] == 0
+
+
+def test_mnli_cli_smoke(tmp_path):
+    words = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + [
+        f"w{i}" for i in range(40)
+    ]
+    vocab = tmp_path / "vocab.txt"
+    vocab.write_text("\n".join(words) + "\n")
+    rs = np.random.RandomState(0)
+    rows = ["\t".join(["index"] + [f"c{i}" for i in range(7)]
+                      + ["sentence1", "sentence2", "x", "gold_label"])]
+    labels = ["entailment", "neutral", "contradiction"]
+    for i in range(16):
+        a = " ".join(rs.choice(words[5:], 4))
+        b = " ".join(rs.choice(words[5:], 4))
+        rows.append(f"{i}\t-\t-\t-\t-\t-\t-\t-\t{a}\t{b}\tx\t{labels[i % 3]}")
+    tsv = tmp_path / "train.tsv"
+    tsv.write_text("\n".join(rows) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tasks", "main.py"),
+         "--task", "MNLI", "--train_data", str(tsv),
+         "--valid_data", str(tsv),
+         "--tokenizer_type", "BertWordPieceLowerCase",
+         "--vocab_file", str(vocab),
+         "--num_layers", "2", "--hidden_size", "64",
+         "--num_attention_heads", "4", "--ffn_hidden_size", "128",
+         "--seq_length", "32", "--max_position_embeddings", "32",
+         "--micro_batch_size", "4", "--data_parallel_size", "1",
+         "--epochs", "1", "--lr", "1e-4"],
+        capture_output=True, text=True, env=env, timeout=600, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert "validation accuracy" in proc.stdout
