@@ -27,6 +27,14 @@ def model_provider(args, mcfg):
         return LlamaModel(mcfg)
     if args.model_name == "falcon":
         return FalconModel(mcfg)
+    if args.model_name == "bert":
+        from megatron_llm_tpu.models import BertModel
+
+        return BertModel(mcfg)
+    if args.model_name == "t5":
+        from megatron_llm_tpu.models import T5Model
+
+        return T5Model(mcfg)
     return GPTModel(mcfg)
 
 

@@ -33,8 +33,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# swept on a real v5e at seq 4096 (b2,g16,d128): 512/1024 beats 256/256
+# by 26% fwd / 51% bwd; _choose_block still shrinks for short sequences
+# and many-q-per-kv GQA groups (MAX_ROWS cap)
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 # cap on folded (position, head) rows per program so fp32 score blocks
 # (rows x block_k) and the accumulators fit VMEM (~16 MB)
 MAX_ROWS = 2048

@@ -43,8 +43,6 @@ def _batches(dataset, batch_size, rng=None, drop_last=True):
            else len(order))
     for i in range(0, end, batch_size):
         idxs = order[i:i + batch_size]
-        if len(idxs) < batch_size and drop_last:
-            break
         yield [dataset[int(j)] for j in idxs]
 
 
@@ -78,7 +76,8 @@ def finetune(model, params, train_ds, valid_ds, *, epochs: int,
              batch_size: int, lr: float, weight_decay: float = 0.01,
              warmup_fraction: float = 0.065, seed: int = 1234,
              tcfg=None, log_interval: int = 50):
-    """Run the finetune loop; returns (params, best_valid_accuracy)
+    """Run the finetune loop; returns (best-epoch params — last-epoch when
+    no validation set — and the best validation accuracy)
     (ref: finetune_utils.finetune :241-337)."""
     from megatron_llm_tpu.config import TrainConfig
 
@@ -115,19 +114,41 @@ def finetune(model, params, train_ds, valid_ds, *, epochs: int,
         stats["loss"] = loss
         return params, opt_state, stats
 
+    # DP > 1: shard batches over the data axis and replicate params so the
+    # jitted step runs GSPMD data-parallel (batches are host-built)
+    from megatron_llm_tpu.parallel.mesh import DATA_AXIS, get_context
+
+    ctx = get_context()
+    batch_sharding = None
+    if ctx is not None and ctx.dp > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        params = jax.device_put(
+            params, jax.tree.map(lambda _: NamedSharding(ctx.mesh, P()),
+                                 params),
+        )
+        batch_sharding = lambda v: jax.device_put(  # noqa: E731
+            v, NamedSharding(ctx.mesh,
+                             P(DATA_AXIS, *([None] * (v.ndim - 1)))),
+        )
+
     rng = np.random.RandomState(seed)
     dropout_key = jax.random.key(seed + 1)
-    best_acc, it = 0.0, 0
+    best_acc, best_params, it = 0.0, None, 0
     for epoch in range(epochs):
         t0 = time.time()
         for samples in _batches(train_ds, batch_size, rng=rng):
             batch = {k: jnp.asarray(v)
                      for k, v in _stack_batch(samples).items()}
+            if batch_sharding is not None:
+                batch = {k: batch_sharding(v) for k, v in batch.items()}
+            # advance first so step 1 trains at max_lr/warmup_steps, not 0
+            # (the reference increments num_steps before applying the lr)
+            sched.step()
             params, opt_state, stats = step(
                 params, opt_state, batch, jnp.float32(sched.get_lr()),
                 jax.random.fold_in(dropout_key, it),
             )
-            sched.step()
             it += 1
             if it % log_interval == 0:
                 print(f"epoch {epoch} iter {it}/{total_steps} | "
@@ -135,7 +156,8 @@ def finetune(model, params, train_ds, valid_ds, *, epochs: int,
                       f"lr {sched.get_lr():.3E}", flush=True)
         if valid_ds is not None and len(valid_ds):
             acc = accuracy(model, params, valid_ds, batch_size)
-            best_acc = max(best_acc, acc)
+            if acc >= best_acc:
+                best_acc, best_params = acc, params
             print(f"epoch {epoch} done in {time.time()-t0:.1f}s | "
                   f"validation accuracy: {acc:.4f}", flush=True)
-    return params, best_acc
+    return (best_params if best_params is not None else params), best_acc

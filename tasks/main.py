@@ -89,6 +89,10 @@ def _finetune_main(args):
         model = MultipleChoice(mcfg)
 
     params = model.init(jax.random.key(tcfg.seed))
+    # --load is the generic flag the LM-eval path uses; accept it as an
+    # alias for --pretrained_checkpoint here
+    if not args.pretrained_checkpoint and args.load:
+        args.pretrained_checkpoint = args.load
     if args.pretrained_checkpoint:
         # Load ENCODER weights from a BERT pretraining checkpoint; heads
         # stay freshly initialized (the reference's strict=False load,
@@ -97,7 +101,7 @@ def _finetune_main(args):
         # merge the overlapping subtrees.
         from megatron_llm_tpu.models import BertModel as _Bert
 
-        loaded = None
+        loaded, errors = None, []
         for binary in (True, False):
             tmpl_cfg = dataclasses.replace(mcfg, add_binary_head=binary)
             tmpl = jax.eval_shape(
@@ -108,14 +112,15 @@ def _finetune_main(args):
                     args.pretrained_checkpoint, tmpl, no_load_optim=True,
                     finetune=True,
                 )
-            except Exception:
+            except Exception as e:
+                errors.append(f"binary_head={binary}: {e!r}")
                 continue
             if restored is not None:
                 loaded = restored[0]
                 break
         assert loaded is not None, (
             f"could not restore encoder weights from "
-            f"{args.pretrained_checkpoint}"
+            f"{args.pretrained_checkpoint}; attempts: {errors}"
         )
         for key in params:
             if key in loaded:
@@ -131,13 +136,19 @@ def _finetune_main(args):
         model, params, train_ds, valid_ds, epochs=args.epochs,
         batch_size=args.micro_batch_size, lr=tcfg.lr,
         weight_decay=tcfg.weight_decay, seed=tcfg.seed,
-        warmup_fraction=args.lr_warmup_fraction or 0.065,
+        warmup_fraction=(args.lr_warmup_fraction
+                         if args.lr_warmup_fraction is not None else 0.065),
         tcfg=tcfg, log_interval=args.log_interval,
     )
     if valid_ds is not None:
         final = accuracy(model, params, valid_ds, args.micro_batch_size)
         print(f"final validation accuracy: {final:.4f} (best {best:.4f})",
               flush=True)
+    if args.save:
+        from megatron_llm_tpu.training.checkpointing import save_checkpoint
+
+        save_checkpoint(args.save, 0, params, None, mcfg)
+        print(f"saved finetuned weights to {args.save}", flush=True)
 
 
 def main(argv=None):

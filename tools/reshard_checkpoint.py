@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Re-save a checkpoint (optionally at a different parallel layout).
+
+Parity target: ref tools/checkpoint_util.py:106-152 — the reference must
+split/merge per-rank shard files when tp/pp changes. Orbax checkpoints
+are layout-free (restore re-shards to whatever mesh the template
+carries; proven by tests/test_fp16_and_checkpoint.py), so this tool is
+mostly a convenience: load the latest (or given) iteration and re-save
+it to a new directory, e.g. to turn a training checkpoint into a
+weights-only `release` checkpoint for the converters, or to materialize
+a copy without optimizer state.
+
+  python tools/reshard_checkpoint.py --load ckpts/run1 --save ckpts/out \
+      --model_name llama2 --model_size 7 [--release] [--iteration N]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from megatron_llm_tpu.arguments import args_to_configs, build_base_parser
+from megatron_llm_tpu.training.checkpointing import (
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def main(argv=None):
+    from finetune import model_provider
+
+    p = build_base_parser()
+    p.add_argument("--release", action="store_true",
+                   help="write a weights-only release checkpoint")
+    p.add_argument("--iteration", type=int, default=None)
+    args = p.parse_args(argv)
+    assert args.load and args.save, "--load and --save are required"
+
+    mcfg, pcfg, tcfg, _ = args_to_configs(args, 0)
+    model = model_provider(args, mcfg)
+    tmpl = jax.eval_shape(model.init, jax.random.key(0))
+    restored = load_checkpoint(args.load, tmpl, model_cfg=None,
+                               no_load_optim=True, iteration=args.iteration)
+    assert restored is not None, f"no checkpoint found in {args.load}"
+    params, _, meta, iteration = restored
+    save_checkpoint(
+        args.save, iteration, params, None, mcfg,
+        consumed_train_samples=meta.get("consumed_train_samples", 0),
+        release=args.release,
+    )
+    print(f"re-saved iteration {iteration} from {args.load} to {args.save}"
+          f"{' (release)' if args.release else ''}")
+
+
+if __name__ == "__main__":
+    main()

@@ -64,8 +64,7 @@ def main():
         hf = AutoModelForCausalLM.from_pretrained(
             args.hf_dir, torch_dtype=torch.float32
         ).eval()
-    else:
-        assert args.model == "llama", "hermetic mode supports llama"
+    elif args.model == "llama":
         hf = LlamaForCausalLM(LlamaConfig(
             vocab_size=args.vocab_size, hidden_size=args.hidden_size,
             intermediate_size=int(args.hidden_size * 8 / 3 // 16 * 16),
@@ -74,6 +73,21 @@ def main():
             num_key_value_heads=args.num_kv_heads,
             max_position_embeddings=max(2048, args.seq_length),
             tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+        )).float().eval()
+    else:
+        # hermetic falcon: --num_kv_heads 1 builds the 7b MQA style,
+        # >1 the 40b grouped (new_decoder_architecture) style — both
+        # converter layouts get exercised
+        from transformers import FalconConfig, FalconForCausalLM
+
+        mqa = args.num_kv_heads == 1
+        hf = FalconForCausalLM(FalconConfig(
+            vocab_size=args.vocab_size, hidden_size=args.hidden_size,
+            num_hidden_layers=args.num_layers,
+            num_attention_heads=args.num_heads,
+            num_kv_heads=args.num_kv_heads,
+            multi_query=mqa, new_decoder_architecture=not mqa,
+            parallel_attn=True, bias=False, alibi=False,
         )).float().eval()
 
     cfg = _model_cfg_from_hf(args.model, hf.config, "float32")
