@@ -148,6 +148,21 @@ class MegatronGenerate:
 class _Handler(BaseHTTPRequestHandler):
     generator: Optional[MegatronGenerate] = None
 
+    def do_GET(self):
+        # the reference serves its static generation UI at /
+        # (megatron/static/index.html via flask static routing)
+        if self.path in ("/", "/index.html"):
+            from megatron_llm_tpu.inference.static_ui import INDEX_HTML
+
+            data = INDEX_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        self.send_error(404)
+
     def do_PUT(self):
         if self.path.rstrip("/") != "/api":
             self.send_error(404)

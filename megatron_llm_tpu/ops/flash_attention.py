@@ -41,6 +41,10 @@ DEFAULT_BLOCK_K = 1024
 # cap on folded (position, head) rows per program so fp32 score blocks
 # (rows x block_k) and the accumulators fit VMEM (~16 MB)
 MAX_ROWS = 2048
+# cap on rows*block_k fp32 score cells per program (4 MB per buffer; the
+# backward holds two such blocks) — keeps wide-GQA shapes inside VMEM now
+# that the default block_k is 1024
+MAX_CELLS = 1 << 20
 
 
 def _xla_reference(q, k, v, causal: bool):
@@ -416,6 +420,13 @@ def flash_attention(
         qpk = q.shape[3]
         bq = _choose_block(s, block_q, qpk)
         bk = _choose_block(t, block_k)
+        # bound the fp32 score block rows*block_k (VMEM)
+        while (bq is not None and bk is not None and bk > 128
+               and bq * qpk * bk > MAX_CELLS):
+            bk = _choose_block(t, bk // 2)
+        while (bq is not None and bk is not None
+               and bq * qpk * bk > MAX_CELLS and bq * qpk > 256):
+            bq = _choose_block(s, bq // 2, qpk)
         if bq is not None and bk is not None and d % 128 == 0:
             return _flash((causal, bq, bk, interpret), q, k, v)
     return _xla_reference(q, k, v, causal)

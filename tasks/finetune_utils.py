@@ -123,6 +123,12 @@ def finetune(model, params, train_ds, valid_ds, *, epochs: int,
     if ctx is not None and ctx.dp > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # batch_size is the GLOBAL batch: each of the dp devices computes
+        # batch_size/dp samples of it (same convention as the pretraining
+        # loader's mbs*dp global microbatch)
+        assert batch_size % ctx.dp == 0, (
+            f"batch size {batch_size} must divide dp={ctx.dp}"
+        )
         params = jax.device_put(
             params, jax.tree.map(lambda _: NamedSharding(ctx.mesh, P()),
                                  params),

@@ -350,5 +350,12 @@ def test_server_validation_and_generate(tiny_model):
         assert status == 200
         assert isinstance(body["text"], list)
         assert body["text"][0].startswith("ab")
+        # static generation UI at / (ref: megatron/static/index.html)
+        conn = HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/")
+        resp = conn.getresponse()
+        page = resp.read().decode()
+        conn.close()
+        assert resp.status == 200 and "<textarea" in page
     finally:
         httpd.shutdown()

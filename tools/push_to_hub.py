@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Upload a converted HF-format checkpoint directory to the Hub.
+
+Parity target: ref tools/push_to_hub.py:1-161 — takes the output of the
+native->HF converter (tools/convert_weights.py --reverse) and publishes
+it. Thin by design: conversion is the converter's job; this only ships
+the directory.
+
+  python tools/push_to_hub.py /path/to/hf_dir --hf_repo_name org/name \
+      [--branch main] [--private]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("hf_dir", help="converted HF checkpoint directory")
+    p.add_argument("--hf_repo_name", required=True)
+    p.add_argument("--branch", default="main")
+    p.add_argument("--private", action="store_true")
+    args = p.parse_args(argv)
+
+    assert os.path.isdir(args.hf_dir), args.hf_dir
+    try:
+        from huggingface_hub import HfApi
+    except ImportError:
+        print("huggingface_hub is not installed; `pip install "
+              "huggingface_hub` and authenticate with `huggingface-cli "
+              "login` first", file=sys.stderr)
+        return 1
+
+    api = HfApi()
+    api.create_repo(args.hf_repo_name, private=args.private, exist_ok=True)
+    if args.branch != "main":
+        api.create_branch(args.hf_repo_name, branch=args.branch,
+                          exist_ok=True)
+    api.upload_folder(
+        folder_path=args.hf_dir,
+        repo_id=args.hf_repo_name,
+        revision=args.branch,
+        commit_message=f"upload from {os.path.basename(args.hf_dir)}",
+    )
+    print(f"uploaded {args.hf_dir} -> {args.hf_repo_name}@{args.branch}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

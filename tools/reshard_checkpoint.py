@@ -41,6 +41,32 @@ def main(argv=None):
     assert args.load and args.save, "--load and --save are required"
 
     mcfg, pcfg, tcfg, _ = args_to_configs(args, 0)
+
+    # the checkpoint's meta.json records the true padded vocab; use it so
+    # the restore template matches checkpoints trained with a
+    # tokenizer-derived vocab rather than the preset default
+    import dataclasses
+    import json
+
+    from megatron_llm_tpu.training.checkpointing import (
+        checkpoint_dir,
+        read_tracker,
+    )
+
+    it, release = read_tracker(args.load)
+    meta_path = os.path.join(
+        checkpoint_dir(args.load, args.iteration or it or 0,
+                       release=release and args.iteration is None),
+        "meta.json",
+    )
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            saved = json.load(f).get("config", {})
+        if saved.get("padded_vocab_size"):
+            mcfg = dataclasses.replace(
+                mcfg, padded_vocab_size=int(saved["padded_vocab_size"])
+            )
+
     model = model_provider(args, mcfg)
     tmpl = jax.eval_shape(model.init, jax.random.key(0))
     restored = load_checkpoint(args.load, tmpl, model_cfg=None,
