@@ -60,6 +60,12 @@ def main(argv=None):
 
     maybe_initialize_distributed()
     mcfg, pcfg, tcfg, dargs = args_to_configs(args, vocab_size)
+    if args.use_checkpoint_args and args.load:
+        from megatron_llm_tpu.training.checkpointing import (
+            load_model_config_from_checkpoint,
+        )
+
+        mcfg = load_model_config_from_checkpoint(args.load, mcfg)
 
     print(f"devices: {len(jax.devices())} ({jax.default_backend()}); "
           f"mesh dp={pcfg.data_parallel_size} pp={pcfg.pipeline_parallel_size} "

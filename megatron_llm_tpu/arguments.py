@@ -118,6 +118,9 @@ def build_base_parser() -> argparse.ArgumentParser:
     g.add_argument("--save", type=str, default=None)
     g.add_argument("--save_interval", type=int, default=None)
     g.add_argument("--load", type=str, default=None)
+    # ref: --use_checkpoint_args (checkpointing.py:476 load_args_from_
+    # checkpoint): take the model architecture from the checkpoint's meta
+    g.add_argument("--use_checkpoint_args", action="store_true")
     g.add_argument("--finetune", action="store_true")
     g.add_argument("--no_load_optim", action="store_true")
     g.add_argument("--no_load_rng", action="store_true")

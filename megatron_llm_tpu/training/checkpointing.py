@@ -119,6 +119,60 @@ def save_checkpoint(
     return path
 
 
+# The ARCHITECTURE fields --use_checkpoint_args may overlay — exactly the
+# check_checkpoint_args critical set plus the shape-determining extras.
+# Training knobs (dropout, recompute, flash, seq_length, ...) stay with
+# the CLI, matching the reference's _set_arg force-list
+# (ref: load_args_from_checkpoint checkpointing.py:506-560).
+_CHECKPOINT_ARCH_FIELDS = (
+    "num_layers", "hidden_size", "num_attention_heads",
+    "num_attention_heads_kv", "kv_channels", "ffn_hidden_size",
+    "padded_vocab_size", "position_embedding_type", "glu_activation",
+    "hidden_act", "use_rms_norm", "use_bias", "tie_embed_logits",
+    "parallel_attn", "parallel_layernorm", "use_post_ln",
+    "layernorm_epsilon", "rope_theta", "rope_scaling_factor",
+    "max_position_embeddings", "num_tokentypes", "add_binary_head",
+)
+
+
+def load_model_config_from_checkpoint(load_dir: str, mcfg):
+    """Overlay the architecture recorded in a checkpoint's meta.json onto
+    `mcfg` (ref: load_args_from_checkpoint checkpointing.py:476-560 +
+    --use_checkpoint_args). Only the architecture fields listed above are
+    taken (training knobs keep their CLI values); None round-trips.
+    Returns the updated config, or the input unchanged when no
+    checkpoint/meta exists."""
+    iteration, release = read_tracker(load_dir)
+    if iteration is None and not release:
+        return mcfg
+    meta_path = os.path.join(
+        checkpoint_dir(load_dir, iteration or 0, release=release),
+        "meta.json",
+    )
+    if not os.path.exists(meta_path):
+        return mcfg
+    with open(meta_path) as f:
+        saved = json.load(f).get("config", {})
+    updates = {}
+    for name in _CHECKPOINT_ARCH_FIELDS:
+        if name not in saved or not hasattr(mcfg, name):
+            continue
+        val = saved[name]
+        cur = getattr(mcfg, name)
+        if not isinstance(val, (int, float, bool, str, type(None))):
+            continue
+        if val is None or cur is None:
+            if val != cur:
+                updates[name] = val
+        elif val != cur:
+            updates[name] = type(cur)(val)
+    if updates:
+        print(f" > using checkpoint args from {meta_path}: "
+              f"{sorted(updates)}", flush=True)
+        mcfg = dataclasses.replace(mcfg, **updates)
+    return mcfg
+
+
 def load_checkpoint(
     load_dir: str,
     params_template: Any,

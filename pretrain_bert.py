@@ -72,6 +72,12 @@ def main(argv=None):
 
     binary_head = not args.no_binary_head
     mcfg = dataclasses.replace(mcfg, add_binary_head=binary_head)
+    if args.use_checkpoint_args and args.load:
+        from megatron_llm_tpu.training.checkpointing import (
+            load_model_config_from_checkpoint,
+        )
+
+        mcfg = load_model_config_from_checkpoint(args.load, mcfg)
     assert pcfg.pipeline_parallel_size == 1, \
         "encoder pretraining: pp>1 not supported (GPT-only pipeline)"
 

@@ -69,6 +69,12 @@ def main(argv=None):
     import dataclasses
 
     mcfg = dataclasses.replace(mcfg, add_binary_head=False)
+    if args.use_checkpoint_args and args.load:
+        from megatron_llm_tpu.training.checkpointing import (
+            load_model_config_from_checkpoint,
+        )
+
+        mcfg = load_model_config_from_checkpoint(args.load, mcfg)
     assert pcfg.pipeline_parallel_size == 1
 
     initialize_parallel(

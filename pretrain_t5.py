@@ -76,6 +76,12 @@ def main(argv=None):
         max_position_embeddings=max(mcfg.seq_length,
                                     args.decoder_seq_length),
     )
+    if args.use_checkpoint_args and args.load:
+        from megatron_llm_tpu.training.checkpointing import (
+            load_model_config_from_checkpoint,
+        )
+
+        mcfg = load_model_config_from_checkpoint(args.load, mcfg)
     assert pcfg.pipeline_parallel_size == 1, \
         "encoder-decoder pretraining: pp>1 not supported"
 

@@ -126,6 +126,27 @@ def test_weight_decay_skips_1d_params():
 # ---------------------------------------------------------------------------
 
 
+def test_use_checkpoint_args_overlay(tmp_path):
+    """--use_checkpoint_args: architecture comes from the checkpoint's
+    meta (ref: load_args_from_checkpoint checkpointing.py:476-560)."""
+    from megatron_llm_tpu.models import LlamaModel
+    from megatron_llm_tpu.training.checkpointing import (
+        load_model_config_from_checkpoint,
+        save_checkpoint,
+    )
+
+    cfg = _tiny(num_layers=3)
+    model = LlamaModel(cfg)
+    save_checkpoint(str(tmp_path), 1, model.init(jax.random.key(0)), None,
+                    cfg)
+    wrong = _tiny(num_layers=5)
+    fixed = load_model_config_from_checkpoint(str(tmp_path), wrong)
+    assert fixed.num_layers == 3
+    # missing dir leaves the config untouched
+    same = load_model_config_from_checkpoint(str(tmp_path / "nope"), wrong)
+    assert same.num_layers == 5
+
+
 def test_checkpoint_restores_under_different_mesh(tmp_path):
     from megatron_llm_tpu.parallel import initialize_parallel
     from megatron_llm_tpu.parallel.mesh import destroy_parallel
