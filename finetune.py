@@ -27,14 +27,15 @@ def model_provider(args, mcfg):
         return LlamaModel(mcfg)
     if args.model_name == "falcon":
         return FalconModel(mcfg)
-    if args.model_name == "bert":
-        from megatron_llm_tpu.models import BertModel
-
-        return BertModel(mcfg)
-    if args.model_name == "t5":
-        from megatron_llm_tpu.models import T5Model
-
-        return T5Model(mcfg)
+    if args.model_name in ("bert", "t5"):
+        # The shared Trainer path here feeds GPT-style batches
+        # (tokens/labels/position_ids/causal mask) which the encoder
+        # models' loss signatures don't accept, and dataset_provider
+        # builds GPT token streams, not masked-LM corpora.
+        raise SystemExit(
+            f"--model_name {args.model_name}: use pretrain_{args.model_name}.py "
+            "(masked-LM/span-corruption data + matching batch builder)"
+        )
     return GPTModel(mcfg)
 
 
@@ -69,11 +70,13 @@ def main(argv=None):
 
     print(f"devices: {len(jax.devices())} ({jax.default_backend()}); "
           f"mesh dp={pcfg.data_parallel_size} pp={pcfg.pipeline_parallel_size} "
-          f"tp={pcfg.tensor_parallel_size} sp={pcfg.sequence_parallel}")
+          f"cp={pcfg.context_parallel_size} tp={pcfg.tensor_parallel_size} "
+          f"sp={pcfg.sequence_parallel}")
     initialize_parallel(
         dp=pcfg.data_parallel_size,
         pp=pcfg.pipeline_parallel_size,
         tp=pcfg.tensor_parallel_size,
+        cp=pcfg.context_parallel_size,
         sequence_parallel=pcfg.sequence_parallel,
     )
 

@@ -144,6 +144,9 @@ def build_base_parser() -> argparse.ArgumentParser:
                    default=None, help=argparse.SUPPRESS)
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--data_parallel_size", type=int, default=None)
+    # context parallelism (ring attention over the sequence axis) — a
+    # beyond-reference long-context axis; see ParallelConfig.
+    g.add_argument("--context_parallel_size", type=int, default=1)
 
     g = p.add_argument_group("validation")  # ref :870-877
     g.add_argument("--eval_iters", type=int, default=100)
@@ -252,15 +255,17 @@ def args_to_configs(args, padded_vocab_size: int):
 
     import jax
 
+    cp = getattr(args, "context_parallel_size", 1) or 1
     dp = args.data_parallel_size
     if dp is None:
-        dp = max(1, len(jax.devices()) // (tp * pp))
+        dp = max(1, len(jax.devices()) // (tp * pp * cp))
     gbs = args.global_batch_size or args.micro_batch_size * dp
     num_micro = gbs // (args.micro_batch_size * dp)
     pcfg = ParallelConfig(
         data_parallel_size=dp,
         pipeline_parallel_size=pp,
         tensor_parallel_size=tp,
+        context_parallel_size=cp,
         sequence_parallel=args.sequence_parallel,
         use_distributed_optimizer=args.use_distributed_optimizer,
         num_microbatches=num_micro,

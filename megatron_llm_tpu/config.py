@@ -154,6 +154,12 @@ class ParallelConfig:
     data_parallel_size: int = 1
     pipeline_parallel_size: int = 1
     tensor_parallel_size: int = 1
+    # Context parallelism: the sequence axis sharded over the `context`
+    # mesh axis, exact ring attention at every layer
+    # (parallel/ring_attention.py). BEYOND-reference capability — the
+    # reference's only long-sequence lever is SP + selective recompute
+    # (ref: transformer.py:508-523); cp shards the N^2 attention itself.
+    context_parallel_size: int = 1
     # NOTE deliberately absent: virtual/interleaved pipeline
     # (ref: --num_layers_per_virtual_pipeline_stage arguments.py:828).
     # vpp exists to shrink the pipeline bubble when 1F1B's memory
@@ -179,6 +185,7 @@ class ParallelConfig:
         return (
             self.data_parallel_size
             * self.pipeline_parallel_size
+            * self.context_parallel_size
             * self.tensor_parallel_size
         )
 
@@ -187,6 +194,7 @@ class ParallelConfig:
         return (
             self.data_parallel_size,
             self.pipeline_parallel_size,
+            self.context_parallel_size,
             self.tensor_parallel_size,
         )
 

@@ -47,7 +47,12 @@ def get_samples_mapping(indexed_dataset, data_prefix, num_epochs,
         fname += f"_{num_epochs}ep"
     if max_num_samples != (np.iinfo(np.int64).max - 1):
         fname += f"_{max_num_samples}mns"
-    fname += f"_{max_seq_length}msl_{short_seq_prob:0.2f}ssp_{seed}s.npy"
+    fname += f"_{max_seq_length}msl_{short_seq_prob:0.2f}ssp_{seed}s"
+    # The split is a DOC-RANGE view; a different --split must not reuse a
+    # mapping built for another doc range (the reference shares this wart
+    # — its filename omits the range too, dataset_utils.py:653-668).
+    doc_idx = np.asarray(indexed_dataset.doc_idx, np.int64)
+    fname += f"_{int(doc_idx[0])}-{int(doc_idx[-1])}x{len(doc_idx)}docs.npy"
 
     if not os.path.isfile(fname):
         t0 = time.time()

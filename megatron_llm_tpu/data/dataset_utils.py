@@ -69,6 +69,13 @@ def build_train_valid_test_datasets(
     def build_split(index, name):
         if splits[index + 1] <= splits[index]:
             return None
+        # A split whose requested sample budget is zero (e.g. the test split
+        # when no test iterations are scheduled) must not be built:
+        # get_samples_mapping requires max_num_samples>0 or num_epochs
+        # (the reference always passes test_iters*global_batch_size,
+        # ref: training.py build_train_valid_test_data_iterators).
+        if not train_valid_test_num_samples[index]:
+            return None
         view = DocRangeView(indexed, splits[index], splits[index + 1])
         kwargs = dict(
             name=name,

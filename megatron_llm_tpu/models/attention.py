@@ -28,7 +28,41 @@ import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.models.rope import apply_rope
-from megatron_llm_tpu.parallel.mesh import shard_activation
+from megatron_llm_tpu.parallel.mesh import (
+    CONTEXT_AXIS,
+    get_context,
+    in_manual_region,
+    shard_activation,
+)
+
+
+def _ring_dispatch(pctx, q, k, v):
+    """Ring attention over the `context` mesh axis. Outside any manual
+    region: a seq-sharded shard_map with `data`/`model` GSPMD-auto inside.
+    Inside the pipeline's manual region `context` is already a manual axis
+    of the enclosing shard_map (pipeline.py declares it when cp>1), so the
+    ring body is called directly on the local seq shard."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from megatron_llm_tpu.parallel.ring_attention import ring_self_attention
+
+    if in_manual_region():
+        return ring_self_attention(q, k, v, CONTEXT_AXIS, causal=True)
+
+    qspec = P(None, CONTEXT_AXIS, None, None, None)
+    kspec = P(None, CONTEXT_AXIS, None, None)
+    ring = jax.shard_map(
+        functools.partial(
+            ring_self_attention, axis_name=CONTEXT_AXIS, causal=True
+        ),
+        in_specs=(qspec, kspec, kspec),
+        out_specs=qspec,
+        axis_names={CONTEXT_AXIS},
+        mesh=pctx.mesh,
+    )
+    return ring(q, k, v)
 
 
 def split_qkv(mixed: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -192,10 +226,22 @@ def attention_block(
         # flash path has no dropout support: fall back to the grouped path
         # when attention dropout is live (ADVICE r1; the reference's
         # FlashSelfAttention passes dropout to the CUDA kernel instead)
-        flash_ok = cfg.use_flash_attn and mask is None and (
-            deterministic or cfg.attention_dropout == 0.0
+        no_dropout = deterministic or cfg.attention_dropout == 0.0
+        pctx = get_context()
+        # Context parallelism: when the mesh has a context axis, attention
+        # is the ONE op that mixes sequence positions — run the exact ring
+        # (scan + ppermute, parallel/ring_attention.py) over seq shards.
+        # RoPE was applied above with global position_ids, so q/k enter the
+        # ring already rotated. Custom masks / live attention dropout fall
+        # through to the gathered path (correct, not seq-sharded).
+        ring_ok = (
+            pctx is not None and pctx.cp > 1 and mask is None and no_dropout
         )
-        if flash_ok:
+        flash_ok = cfg.use_flash_attn and mask is None and no_dropout
+        if ring_ok:
+            ctx = _ring_dispatch(pctx, q, k, v)
+            ctx = ctx.reshape(b, s, -1)
+        elif flash_ok:
             from megatron_llm_tpu.ops.flash_attention import flash_attention
 
             ctx = flash_attention(q, k, v, causal=True)

@@ -181,17 +181,24 @@ def transformer_layer(
         out = residual + _dropout(attn_out + mlp_out, p_hidden, h1_rng, deterministic)
     elif cfg.use_post_ln:
         x = residual + _dropout(attn_out, p_hidden, h1_rng, deterministic)
+        x = shard_activation(x, "hidden_seq")
         x = apply_norm(x, layer_params["post_attention_norm"], cfg)
         mlp_out = mlp_block(layer_params["mlp"], cfg, x, h2_rng, deterministic)
         out = x + _dropout(mlp_out, p_hidden, h2_rng, deterministic)
         # final norm handled by caller; post-LN applies input_norm after attn
     else:
         x = residual + _dropout(attn_out, p_hidden, h1_rng, deterministic)
+        # mid-layer norm/dropout region: seq-sharded under SP (the
+        # reduce-scatter after the row-parallel wo, ref: layers.py:225-296)
+        x = shard_activation(x, "hidden_seq")
         normed2 = apply_norm(x, layer_params["post_attention_norm"], cfg)
         mlp_out = mlp_block(layer_params["mlp"], cfg, normed2, h2_rng, deterministic)
         out = x + _dropout(mlp_out, p_hidden, h2_rng, deterministic)
 
-    out = shard_activation(out, "hidden")
+    # layer boundary = norm/dropout region: under SP the saved residual is
+    # seq-sharded over (context, model) — the per-layer memory / tp saving
+    # the reference's SP exists for (ref: layers.py:225-296)
+    out = shard_activation(out, "hidden_seq")
     return out, new_cache
 
 

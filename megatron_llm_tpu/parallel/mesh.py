@@ -24,8 +24,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 STAGE_AXIS = "stage"
+CONTEXT_AXIS = "context"
 MODEL_AXIS = "model"
-AXIS_NAMES = (DATA_AXIS, STAGE_AXIS, MODEL_AXIS)
+AXIS_NAMES = (DATA_AXIS, STAGE_AXIS, CONTEXT_AXIS, MODEL_AXIS)
 
 _CONTEXT: Optional["ParallelContext"] = None
 
@@ -54,6 +55,12 @@ def maybe_initialize_distributed() -> int:
         for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
                   "MEGASCALE_COORDINATOR_ADDRESS")
     )
+    # GCE/GKE TPU pods set none of the coordinator vars — jax auto-detects
+    # the cluster from TPU metadata. Detect the multi-host pod from the
+    # worker-hostnames metadata env var the TPU runtime publishes.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h]) > 1:
+        multiproc_env = True
     if multiproc_env:
         try:
             jax.distributed.initialize()
@@ -68,19 +75,24 @@ def build_mesh(
     pp: int = 1,
     tp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    cp: int = 1,
 ) -> Mesh:
-    """Build the (data, stage, model) mesh.
+    """Build the (data, stage, context, model) mesh.
 
     Axis order puts `model` innermost so TP collectives ride the
     fastest ICI links (analogue of the reference keeping TP within a node,
-    ref: docs/guide/faq.md policy "TP <= GPUs/node").
+    ref: docs/guide/faq.md policy "TP <= GPUs/node"); `context` sits just
+    outside so the ring-attention ppermute hops are next-nearest.
     """
     if devices is None:
         devices = jax.devices()
-    n = dp * pp * tp
+    n = dp * pp * cp * tp
     if len(devices) < n:
-        raise ValueError(f"need {n} devices for dp={dp} pp={pp} tp={tp}, have {len(devices)}")
-    dev_array = np.asarray(devices[:n]).reshape(dp, pp, tp)
+        raise ValueError(
+            f"need {n} devices for dp={dp} pp={pp} cp={cp} tp={tp}, "
+            f"have {len(devices)}"
+        )
+    dev_array = np.asarray(devices[:n]).reshape(dp, pp, cp, tp)
     return Mesh(dev_array, AXIS_NAMES)
 
 
@@ -102,12 +114,16 @@ class ParallelContext:
         return self.mesh.shape[STAGE_AXIS]
 
     @property
+    def cp(self) -> int:
+        return self.mesh.shape[CONTEXT_AXIS]
+
+    @property
     def tp(self) -> int:
         return self.mesh.shape[MODEL_AXIS]
 
     @property
     def world_size(self) -> int:
-        return self.dp * self.pp * self.tp
+        return self.dp * self.pp * self.cp * self.tp
 
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
@@ -115,12 +131,12 @@ class ParallelContext:
 
 def initialize_parallel(
     dp: int = 1, pp: int = 1, tp: int = 1, sequence_parallel: bool = False,
-    devices: Optional[Sequence[jax.Device]] = None,
+    devices: Optional[Sequence[jax.Device]] = None, cp: int = 1,
 ) -> ParallelContext:
     """Create and install the global context (ref analogue:
     initialize_model_parallel, parallel_state.py:51)."""
     global _CONTEXT
-    mesh = build_mesh(dp, pp, tp, devices)
+    mesh = build_mesh(dp, pp, tp, devices, cp=cp)
     _CONTEXT = ParallelContext(mesh=mesh, sequence_parallel=sequence_parallel)
     return _CONTEXT
 
@@ -154,24 +170,31 @@ def use_mesh(ctx: ParallelContext):
 # when no mesh is installed these are no-ops, so single-device code paths are
 # identical. GSPMD propagates everything else.
 
+# The sequence dim is ALWAYS sharded over `context` (a size-1 no-op unless
+# context parallelism is on — ring attention handles the one op that mixes
+# sequence positions). Under sequence parallelism the norm/dropout regions
+# ("hidden_seq") shard seq over `model` TOO: GSPMD then materialises the
+# reference's SP all-gather-before-column-parallel / reduce-scatter-after-
+# row-parallel pattern (ref: mappings.py:191-246, layers.py:225-296) from
+# the transition between "hidden_seq" and the matmul-region specs below,
+# and every saved residual/norm activation costs 1/tp the memory.
 _ACTIVATION_SPECS = {
-    # (batch, seq, hidden) residual stream
-    "hidden": P(DATA_AXIS, None, None),
-    # (batch, seq, hidden) in the norm/dropout regions under sequence
-    # parallelism — seq dim sharded over the model axis
-    # (ref: mappings.py:191-246 scatter/gather_to_sequence_parallel_region)
-    "hidden_seq": P(DATA_AXIS, MODEL_AXIS, None),
+    # (batch, seq, hidden) residual stream at matmul regions
+    "hidden": P(DATA_AXIS, CONTEXT_AXIS, None),
+    # (batch, seq, hidden) at layer boundaries / norm+dropout regions —
+    # seq additionally sharded over `model` under sequence parallelism
+    "hidden_seq": P(DATA_AXIS, (CONTEXT_AXIS, MODEL_AXIS), None),
     # (batch, seq, heads, head_dim) — heads over model axis (TP attention)
-    "heads": P(DATA_AXIS, None, MODEL_AXIS, None),
+    "heads": P(DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS, None),
     # (batch, seq, kv_heads, q_per_kv, head_dim) grouped GQA layout
-    "groups": P(DATA_AXIS, None, MODEL_AXIS, None, None),
+    "groups": P(DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS, None, None),
     # (batch, seq, ffn) MLP intermediate — ffn over model axis
-    "ffn": P(DATA_AXIS, None, MODEL_AXIS),
+    "ffn": P(DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS),
     # (batch, seq, 2, ffn) GLU intermediate, gate/up axis unsharded
-    "glu_ffn": P(DATA_AXIS, None, None, MODEL_AXIS),
+    "glu_ffn": P(DATA_AXIS, CONTEXT_AXIS, None, MODEL_AXIS),
     # (batch, seq, vocab) logits — vocab-parallel
     # (ref: layers.py:128-210 VocabParallelEmbedding / parallel_lm_logits)
-    "logits": P(DATA_AXIS, None, MODEL_AXIS),
+    "logits": P(DATA_AXIS, CONTEXT_AXIS, MODEL_AXIS),
 }
 
 
@@ -190,6 +213,10 @@ def manual_region():
         yield
     finally:
         _MANUAL_DEPTH -= 1
+
+
+def in_manual_region() -> bool:
+    return _MANUAL_DEPTH > 0
 
 
 def shard_activation(x, kind: str):

@@ -51,6 +51,7 @@ from megatron_llm_tpu.models.transformer import transformer_stack
 from megatron_llm_tpu.models.language_model import embed_tokens, lm_logits
 from megatron_llm_tpu.parallel.cross_entropy import cross_entropy
 from megatron_llm_tpu.parallel.mesh import (
+    CONTEXT_AXIS,
     DATA_AXIS,
     MODEL_AXIS,
     STAGE_AXIS,
@@ -119,6 +120,17 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
     cfg = model.cfg
     mesh = ctx.mesh
     num_stages = pcfg.pipeline_parallel_size
+    # Context parallelism inside the pipeline: `context` joins `stage` as a
+    # manual axis of the SAME shard_map (Shardy rejects a nested manual
+    # region whose operands mix free `stage` with manual `context`), the
+    # seq dim of every batch operand is context-sharded, and attention runs
+    # the ring directly over the manual axis (models/attention.py routes
+    # there via in_manual_region()).
+    cp = ctx.cp
+    if cp > 1:
+        assert cfg.attention_dropout == 0.0, (
+            "cp>1 pipelined training: ring attention has no dropout path"
+        )
 
     def loss_fn(params, batch, dropout_rng=None):
         tokens = batch["tokens"]  # (num_micro, b, s)
@@ -179,6 +191,8 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                               rope):
             stage = jax.lax.axis_index(STAGE_AXIS)
             total = num_micro + num_stages - 1
+            manual_axes = (STAGE_AXIS, CONTEXT_AXIS) if cp > 1 \
+                else (STAGE_AXIS,)
 
             # Mark every replicated operand stage-varying up front, while
             # still fp32/int32. If a replicated fp32 param is first cast to
@@ -187,11 +201,32 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
             # XLA-CPU's AllReducePromotion pass aborts cloning it ("Invalid
             # binary instruction opcode copy"); pcast-then-cast sidesteps
             # it and is a free no-op marker on TPU.
-            pv = lambda x: jax.lax.pcast(x, (STAGE_AXIS,), to="varying")  # noqa: E731
+            pv = lambda x: jax.lax.pcast(x, manual_axes, to="varying")  # noqa: E731
+            # batch operands enter context-SHARDED (already context-varying)
+            # — only the stage axis still needs marking on those
+            pv_s = lambda x: jax.lax.pcast(x, (STAGE_AXIS,), to="varying")  # noqa: E731
             aux = jax.tree.map(pv, aux)
-            toks, lbls, lmask, pids, rope = map(pv, (toks, lbls, lmask,
-                                                     pids, rope))
+            rope = pv(rope)
+            toks, lbls, lmask, pids = map(pv_s if cp > 1 else pv,
+                                          (toks, lbls, lmask, pids))
+            if cp > 1:
+                # stage-sharded layer weights enter context-INVARIANT;
+                # mark them context-varying while still fp32 (same
+                # bf16-pvary CPU crash as above otherwise)
+                layers_local = jax.tree.map(
+                    lambda x: jax.lax.pcast(
+                        x, (CONTEXT_AXIS,), to="varying"
+                    ),
+                    layers_local,
+                )
             rope_t = rope if has_rope else None
+            # decorrelate dropout draws across context shards (each shard
+            # holds different global positions)
+            rng_base = dropout_rng
+            if dropout_rng is not None and cp > 1:
+                rng_base = jax.random.fold_in(
+                    dropout_rng, jax.lax.axis_index(CONTEXT_AXIS)
+                )
 
             def head_losses(hidden, lbl_t, lm_t):
                 h = apply_norm(
@@ -199,7 +234,12 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 )
                 logits = lm_logits(aux, cfg, h)
                 losses = cross_entropy(logits, lbl_t)
-                return jnp.sum(losses * lm_t), jnp.sum(lm_t)
+                s_l, d_l = jnp.sum(losses * lm_t), jnp.sum(lm_t)
+                if cp > 1:
+                    # each context shard holds s/cp tokens of the microbatch
+                    s_l = jax.lax.psum(s_l, CONTEXT_AXIS)
+                    d_l = jax.lax.psum(d_l, CONTEXT_AXIS)
+                return s_l, d_l
 
             def tick(carry, t):
                 state, sums, denoms = carry
@@ -207,10 +247,10 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 toks_t = jax.lax.dynamic_index_in_dim(toks, m_in, 0, False)
                 pids_t = jax.lax.dynamic_index_in_dim(pids, m_in, 0, False)
                 rng_e = rng_t = None
-                if dropout_rng is not None:
-                    rng_e = jax.random.fold_in(dropout_rng, m_in)
+                if rng_base is not None:
+                    rng_e = jax.random.fold_in(rng_base, m_in)
                     rng_t = jax.random.fold_in(
-                        dropout_rng, num_micro + 1 + t * num_stages
+                        rng_base, num_micro + 1 + t * num_stages
                     )
                 # in-tick embed: every stage computes the (cheap) gather,
                 # only stage 0 consumes it — no (num_micro,b,s,h) buffer
@@ -219,8 +259,12 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 inp = jnp.where(stage == 0, emb, state).astype(
                     cfg.compute_dtype
                 )
+                # pids_t carries GLOBAL positions (context-sharded when
+                # cp>1): RoPE inside the stage must rotate each seq shard
+                # by its global angle, and --reset_position_ids streams
+                # carry non-arange positions even at cp=1
                 out = _stage_body(cfg, layers_local, inp, rope_t, None,
-                                  None, rng_t, deterministic, stage,
+                                  pids_t, rng_t, deterministic, stage,
                                   num_stages)
                 out = out.astype(boundary_dtype)
 
@@ -272,8 +316,8 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
             # carries become stage-varying inside the loop; mark the zero
             # initials as varying so the scan carry types are stable
             state = jax.lax.pcast(
-                jnp.zeros((b, s, cfg.hidden_size), boundary_dtype),
-                (STAGE_AXIS,), to="varying",
+                jnp.zeros((b, s // cp, cfg.hidden_size), boundary_dtype),
+                manual_axes, to="varying",
             )
             sums0 = jax.lax.pcast(
                 jnp.zeros((num_micro,), jnp.float32), (STAGE_AXIS,),
@@ -292,12 +336,15 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
             # ref: text_generation/communication.py:111).
             return sums[None], denoms[None]
 
+        # (num_micro, b, s) batch operands: seq context-sharded when cp>1
+        bspec = P(None, None, CONTEXT_AXIS) if cp > 1 else P()
         stack_mapped = jax.shard_map(
             stack_shard,
             mesh=mesh,
-            in_specs=(P(STAGE_AXIS), P(), P(), P(), P(), P(), P()),
+            in_specs=(P(STAGE_AXIS), P(), bspec, bspec, bspec, bspec, P()),
             out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)),
-            axis_names={STAGE_AXIS},
+            axis_names={STAGE_AXIS, CONTEXT_AXIS} if cp > 1
+            else {STAGE_AXIS},
         )
         sums, denoms = stack_mapped(
             params["layers"], aux_params, tokens.astype(jnp.int32),

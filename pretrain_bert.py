@@ -81,6 +81,10 @@ def main(argv=None):
     assert pcfg.pipeline_parallel_size == 1, \
         "encoder pretraining: pp>1 not supported (GPT-only pipeline)"
 
+    assert pcfg.context_parallel_size == 1, (
+        "--context_parallel_size: ring attention is causal-only; "
+        "encoder pretraining doesn't support cp"
+    )
     initialize_parallel(
         dp=pcfg.data_parallel_size, pp=1, tp=pcfg.tensor_parallel_size,
         sequence_parallel=pcfg.sequence_parallel,

@@ -71,6 +71,10 @@ def _finetune_main(args):
     args.model_name = "bert"
     mcfg, pcfg, tcfg, _ = args_to_configs(args, tokenizer.vocab_size)
     mcfg = dataclasses.replace(mcfg, add_binary_head=False)
+    assert pcfg.context_parallel_size == 1, (
+        "--context_parallel_size: ring attention is causal-only; "
+        "encoder finetuning tasks don't support cp"
+    )
     initialize_parallel(dp=pcfg.data_parallel_size, pp=1,
                         tp=pcfg.tensor_parallel_size,
                         sequence_parallel=pcfg.sequence_parallel)
@@ -185,6 +189,7 @@ def main(argv=None):
         dp=pcfg.data_parallel_size,
         pp=pcfg.pipeline_parallel_size,
         tp=pcfg.tensor_parallel_size,
+        cp=pcfg.context_parallel_size,
         sequence_parallel=pcfg.sequence_parallel,
     )
 

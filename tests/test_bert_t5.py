@@ -11,6 +11,8 @@ import pytest
 from megatron_llm_tpu.config import bert_config, t5_config
 from megatron_llm_tpu.models import BertModel, T5Model
 
+pytestmark = pytest.mark.slow
+
 
 def _tiny_bert(**over):
     return bert_config(num_layers=2, hidden_size=64, num_attention_heads=4,

@@ -26,8 +26,11 @@ from megatron_llm_tpu.data.masked_lm import create_masked_lm_predictions
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-pytestmark = pytest.mark.skipif(not helpers_available(),
-                                reason="native helpers unavailable")
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not helpers_available(),
+                       reason="native helpers unavailable"),
+]
 
 
 class _Tok:

@@ -23,6 +23,8 @@ from megatron_llm_tpu.models.classification import (
     MultipleChoice,
 )
 
+pytestmark = pytest.mark.slow
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -22,6 +22,8 @@ from megatron_llm_tpu.convert import (
 )
 from megatron_llm_tpu.models import FalconModel, LlamaModel
 
+pytestmark = pytest.mark.slow
+
 torch = pytest.importorskip("torch")
 
 

@@ -19,6 +19,8 @@ from megatron_llm_tpu.ops.flash_attention import (
     flash_attention,
 )
 
+pytestmark = pytest.mark.slow
+
 
 def _rand_qkv(b, s, g, qpk, d, dtype=jnp.float32, seed=0):
     ks = jax.random.split(jax.random.key(seed), 3)

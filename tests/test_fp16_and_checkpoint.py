@@ -24,6 +24,8 @@ from megatron_llm_tpu.optimizer import init_optimizer_state
 from megatron_llm_tpu.optimizer.optimizer import optimizer_step
 from megatron_llm_tpu.training.train_step import make_train_step
 
+pytestmark = pytest.mark.slow
+
 
 def _tiny(num_layers=2):
     return tiny_config(num_layers=num_layers, seq_length=32,

@@ -33,6 +33,8 @@ from megatron_llm_tpu.inference.sampling import (
     sample,
 )
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def tiny_model():

@@ -9,6 +9,8 @@ import pytest
 from megatron_llm_tpu.config import tiny_config
 from megatron_llm_tpu.models import FalconModel, GPTModel, LlamaModel
 
+pytestmark = pytest.mark.slow
+
 
 def test_llama_forward_shapes():
     cfg = tiny_config()

@@ -20,6 +20,8 @@ from megatron_llm_tpu.parallel.pipeline import (
     pipeline_param_specs,
 )
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def pp4():

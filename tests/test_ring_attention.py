@@ -15,6 +15,8 @@ from jax.sharding import Mesh
 from megatron_llm_tpu.models.attention import causal_mask, grouped_attention
 from megatron_llm_tpu.parallel.ring_attention import make_ring_attention
 
+pytestmark = pytest.mark.slow
+
 
 class _Cfg:
     attention_dropout = 0.0

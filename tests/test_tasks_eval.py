@@ -25,6 +25,8 @@ from megatron_llm_tpu.parallel.cross_entropy import (
 from tasks.zeroshot.datasets import build_dataset, build_lm_dataset
 from tasks.zeroshot.evaluate import evaluate_and_print_results
 
+pytestmark = pytest.mark.slow
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -14,6 +14,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 pytest.importorskip("transformers")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
