@@ -1,6 +1,9 @@
 """Benchmark: end-to-end Llama training throughput on one real TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+The headline value is the seq-1024 run; "extra" carries the seq-4096 row,
+explicit MFU for both lengths, and the flash-vs-XLA attention speedup so
+kernel regressions are visible round-over-round (VERDICT r3 #10).
 
 Methodology: the reference's in-repo anchor is the Llama-2-7B fine-tune at
 ~890 tokens/sec/GPU on A100-80GB (BASELINE.md; docs/guide/getting_started.md
@@ -16,7 +19,7 @@ bf16 compute; full remat is memory-forced on this 16GB chip (see inline
 note). MFU is reported against the v5e bf16 peak (197 TFLOP/s), counting
 6*N_params + causal attention FLOPs per token.
 
-Usage: python bench.py [--seq 1024|4096]
+Usage: python bench.py [--seq 1024|4096|0]   (0 = both + kernel ratio)
 """
 
 import argparse
@@ -34,23 +37,8 @@ from megatron_llm_tpu.training import make_train_step
 V5E_PEAK_BF16 = 197e12  # per-chip bf16 FLOP/s
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--seq", type=int, default=1024, choices=[1024, 4096])
-    p.add_argument("--iters", type=int, default=20)
-    args = p.parse_args()
-    assert jax.default_backend() == "tpu", jax.default_backend()
-
-    seq = args.seq
-    # Full remat is memory-forced at 0.74B on the 16GB chip: without it the
-    # live activations need 23G at mbs 8 / seq 1024 (measured), and the
-    # chip tops out at mbs 2 with ~13% lower FLOP/s. Block-remat (fewer
-    # rematted layers) measured flat — the step is compute-bound, not
-    # recompute-bound. seq 4096 fits mbs 6 now that the head+CE is
-    # sequence-chunked (no full fp32 logits buffer).
-    mbs = 8 if seq == 1024 else 6
-
-    cfg = ModelConfig(
+def make_cfg(seq):
+    return ModelConfig(
         num_layers=12,
         hidden_size=2048,
         num_attention_heads=16,
@@ -66,18 +54,28 @@ def main():
         tie_embed_logits=False,
         hidden_dropout=0.0,
         attention_dropout=0.0,
-        params_dtype=jnp.float32,  # fp32 master params, bf16 compute (design contract)
+        params_dtype=jnp.float32,  # fp32 master params, bf16 compute
         use_flash_attn=True,
         recompute_granularity="full",
     )
+
+
+def run_train(seq, iters):
+    """One-chip train-step throughput at `seq`. Returns (tok/s, MFU, 6N)."""
+    # Full remat is memory-forced at 0.74B on the 16GB chip (live
+    # activations need 23G at mbs 8 / seq 1024 without it, measured r1);
+    # mbs swept on-chip r4: 12 peaks at seq 1024 (8/10/14/16/24 all
+    # lower), 6 peaks at seq 4096 (7/8 lower, 10+ OOMs the compiler).
+    mbs = 12 if seq == 1024 else 6
+    cfg = make_cfg(seq)
     model = LlamaModel(cfg)
     params = model.init(jax.random.key(0))
     n_params = sum(p.size for p in jax.tree.leaves(params))
 
     tcfg = TrainConfig(micro_batch_size=mbs, global_batch_size=mbs, lr=1e-4)
-    pcfg = ParallelConfig(num_microbatches=1)
     opt_state = init_optimizer_state(params, tcfg)
-    step = jax.jit(make_train_step(model, tcfg, pcfg), donate_argnums=(0, 1))
+    step = jax.jit(make_train_step(model, tcfg, ParallelConfig(num_microbatches=1)),
+                   donate_argnums=(0, 1))
 
     tokens = jax.random.randint(jax.random.key(1), (1, mbs, seq), 0, 32000)
     batch = {"tokens": tokens, "labels": jnp.roll(tokens, -1, axis=-1)}
@@ -90,37 +88,102 @@ def main():
         params, opt_state, stats = step(params, opt_state, batch, lr, wd)
     float(stats["loss"])
 
-    n_iters = args.iters
     t0 = time.perf_counter()
-    for _ in range(n_iters):
+    for _ in range(iters):
         params, opt_state, stats = step(params, opt_state, batch, lr, wd)
     float(stats["loss"])
     dt = time.perf_counter() - t0
 
-    tok_per_sec = mbs * seq * n_iters / dt
+    tok_per_sec = mbs * seq * iters / dt
     # fwd+bwd model FLOPs per token: 6*N for the matmuls + causal attention
     # (12*L*h*s per token fwd+bwd with the 1/2 causal discount).
     attn_flops_per_tok = 6 * cfg.num_layers * cfg.hidden_size * seq
     flops_per_tok = 6 * n_params + attn_flops_per_tok
     mfu = tok_per_sec * flops_per_tok / V5E_PEAK_BF16
-    # vs_baseline compares 6N-only model FLOP/s on both sides (the A100
-    # anchor's attention FLOPs aren't recoverable from BASELINE.md)
-    achieved_flops = tok_per_sec * 6 * n_params
-    baseline_flops = 890.0 * 6 * 7.0e9  # A100 anchor, BASELINE.md
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"tokens/sec/chip, Llama-arch 0.74B pretrain, seq {seq}, "
-                    f"bf16, flash-attn(Pallas) ON, full remat, "
-                    f"v5e, MFU {mfu:.1%} (FLOP-normalized vs A100 7B anchor)"
-                ),
-                "value": round(tok_per_sec, 1),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(achieved_flops / baseline_flops, 3),
-            }
-        )
+    return tok_per_sec, mfu, n_params
+
+
+def flash_vs_xla_ratio():
+    """fwd+bwd time ratio XLA-attention / Pallas-flash at the bench seq
+    length (b2 keeps the XLA path's fp32 score tensor under HBM; measured
+    r4 on v5e: 2.56x here, 2.96x at s8192, ~1x at s<=2048 where attention
+    is too small to matter)."""
+    from megatron_llm_tpu.ops.flash_attention import (
+        _xla_reference,
+        flash_attention,
     )
+
+    b, s, g, qpk, d = 2, 4096, 16, 1, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, g, qpk, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (b, s, g, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (b, s, g, d), jnp.bfloat16)
+
+    def timed(f):
+        n = 20
+
+        @jax.jit
+        def loop(q, k, v):
+            def body(c, _):
+                o, vjp = jax.vjp(lambda q, k, v: f(q, k, v), *c)
+                dq, dk, dv = vjp(o)
+                return (c[0] + dq * 0, c[1] + dk * 0, c[2] + dv * 0), ()
+            c, _ = jax.lax.scan(body, (q, k, v), None, length=n)
+            return c[0]
+        r = loop(q, k, v)
+        float(jnp.sum(r[0, 0].astype(jnp.float32)))
+        t0 = time.perf_counter()
+        r = loop(q, k, v)
+        float(jnp.sum(r[0, 0].astype(jnp.float32)))
+        return (time.perf_counter() - t0) / n
+
+    t_flash = timed(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    t_xla = timed(lambda q, k, v: _xla_reference(q, k, v, True))
+    return t_xla / t_flash
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=0, choices=[0, 1024, 4096],
+                   help="0 = both lengths + kernel ratio (the artifact run)")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+    assert jax.default_backend() == "tpu", jax.default_backend()
+
+    if args.seq:
+        tok, mfu, n_params = run_train(args.seq, args.iters)
+        print(json.dumps({
+            "metric": (f"tokens/sec/chip, Llama-arch 0.74B pretrain, "
+                       f"seq {args.seq}, bf16, flash-attn(Pallas) ON, "
+                       f"full remat, v5e, MFU {mfu:.1%}"),
+            "value": round(tok, 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": round(tok * 6 * n_params / (890.0 * 6 * 7.0e9), 3),
+        }))
+        return
+
+    tok1, mfu1, n_params = run_train(1024, args.iters)
+    tok4, mfu4, _ = run_train(4096, args.iters)
+    ratio = flash_vs_xla_ratio()
+    achieved = tok1 * 6 * n_params
+    baseline = 890.0 * 6 * 7.0e9  # A100 anchor, BASELINE.md
+    print(json.dumps({
+        "metric": (
+            f"tokens/sec/chip, Llama-arch 0.74B pretrain, seq 1024, bf16, "
+            f"flash-attn(Pallas) ON, full remat, v5e, MFU {mfu1:.1%} "
+            f"(FLOP-normalized vs A100 7B anchor); "
+            f"seq 4096: {tok4:.0f} tok/s, MFU {mfu4:.1%}; "
+            f"flash-vs-XLA fwd+bwd speedup {ratio:.2f}x"
+        ),
+        "value": round(tok1, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(achieved / baseline, 3),
+        "extra": {
+            "mfu_seq1024": round(mfu1, 4),
+            "tok_s_seq4096": round(tok4, 1),
+            "mfu_seq4096": round(mfu4, 4),
+            "flash_vs_xla_fwd_bwd_speedup": round(ratio, 2),
+        },
+    }))
 
 
 if __name__ == "__main__":

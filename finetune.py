@@ -33,8 +33,10 @@ def model_provider(args, mcfg):
         # models' loss signatures don't accept, and dataset_provider
         # builds GPT token streams, not masked-LM corpora.
         raise SystemExit(
-            f"--model_name {args.model_name}: use pretrain_{args.model_name}.py "
-            "(masked-LM/span-corruption data + matching batch builder)"
+            f"--model_name {args.model_name}: to PRETRAIN use "
+            f"pretrain_{args.model_name}.py (masked-LM/span-corruption data "
+            "+ matching batch builder); to FINETUNE a pretrained encoder on "
+            "GLUE/RACE use tasks/main.py"
         )
     return GPTModel(mcfg)
 

@@ -33,10 +33,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-# swept on a real v5e at seq 4096 (b2,g16,d128): 512/1024 beats 256/256
-# by 26% fwd / 51% bwd; _choose_block still shrinks for short sequences
-# and many-q-per-kv GQA groups (MAX_ROWS cap)
-DEFAULT_BLOCK_Q = 512
+# swept on a real v5e (r4, b6/g16/d128 @ seq 4096 and b8 @ 1024):
+# 1024/1024 beats 512/1024 by ~10-12% fwd+bwd at both lengths (and
+# 256/256 by >2x); _choose_block still shrinks for short sequences and
+# many-q-per-kv GQA groups (MAX_ROWS cap), MAX_CELLS bounds VMEM
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 # cap on folded (position, head) rows per program so fp32 score blocks
 # (rows x block_k) and the accumulators fit VMEM (~16 MB)
@@ -171,13 +172,25 @@ def _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret=False):
         qpk=qpk, d=d, num_k_blocks=num_k_blocks, sm_scale=sm_scale,
     )
     grid = (b * g, num_q_blocks, num_k_blocks)
+
+    if causal:
+        # skipped above-diagonal blocks clamp their K/V index to the last
+        # allowed block: Mosaic detects the repeated block index and skips
+        # the DMA, so masked grid steps cost no HBM traffic
+        def kv_index(h, i, j):
+            return (h, jnp.minimum(j, (i * block_q + block_q - 1)
+                                   // block_k), 0)
+    else:
+        def kv_index(h, i, j):
+            return (h, j, 0)
+
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),
@@ -312,10 +325,26 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
     num_q_blocks = s // block_q
     num_k_blocks = t // block_k
 
+    # causal DMA clamps (see _flash_fwd_pallas): masked grid steps re-fetch
+    # the previous block index, which Mosaic elides
+    if causal:
+        def kv_index(h, i, j):
+            return (h, jnp.minimum(j, (i * block_q + block_q - 1)
+                                   // block_k), 0)
+
+        def q_index_t(h, j, i):
+            return (h, jnp.maximum(i, (j * block_k) // block_q), 0)
+    else:
+        def kv_index(h, i, j):
+            return (h, j, 0)
+
+        def q_index_t(h, j, i):
+            return (h, i, 0)
+
     row_specs = [
         pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),  # q
-        pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),        # k
-        pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),        # v
+        pl.BlockSpec((1, block_k, d), kv_index),                         # k
+        pl.BlockSpec((1, block_k, d), kv_index),                         # v
         pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),  # do
         pl.BlockSpec((1, block_q * qpk, 1), lambda h, i, j: (h, i, 0)),  # lse
         pl.BlockSpec((1, block_q * qpk, 1), lambda h, i, j: (h, i, 0)),  # delta
@@ -334,12 +363,12 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
     )(qf, kf, vf, dof, lse, delta)
 
     col_specs = [
-        pl.BlockSpec((1, block_q, qpk * d), lambda h, j, i: (h, i, 0)),  # q
+        pl.BlockSpec((1, block_q, qpk * d), q_index_t),                  # q
         pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),        # k
         pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),        # v
-        pl.BlockSpec((1, block_q, qpk * d), lambda h, j, i: (h, i, 0)),  # do
-        pl.BlockSpec((1, block_q * qpk, 1), lambda h, j, i: (h, i, 0)),  # lse
-        pl.BlockSpec((1, block_q * qpk, 1), lambda h, j, i: (h, i, 0)),  # delta
+        pl.BlockSpec((1, block_q, qpk * d), q_index_t),                  # do
+        pl.BlockSpec((1, block_q * qpk, 1), q_index_t),                  # lse
+        pl.BlockSpec((1, block_q * qpk, 1), q_index_t),                  # delta
     ]
     dk, dv = pl.pallas_call(
         functools.partial(

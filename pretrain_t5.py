@@ -87,7 +87,7 @@ def main(argv=None):
 
     assert pcfg.context_parallel_size == 1, (
         "--context_parallel_size: ring attention is causal-only; "
-        "encoder pretraining doesn't support cp"
+        "encoder-decoder pretraining doesn't support cp"
     )
     initialize_parallel(
         dp=pcfg.data_parallel_size, pp=1, tp=pcfg.tensor_parallel_size,
