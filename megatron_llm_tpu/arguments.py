@@ -92,6 +92,9 @@ def build_base_parser() -> argparse.ArgumentParser:
     g.add_argument("--exit_interval", type=int, default=None)
     g.add_argument("--exit_duration_in_mins", type=float, default=None)
     g.add_argument("--exit_signal_handler", action="store_true")
+    # sentinel-file autoresume (TPU analogue of ref --adlr_autoresume)
+    g.add_argument("--autoresume_file", type=str, default=None)
+    g.add_argument("--autoresume_interval", type=int, default=50)
     g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
     g.add_argument("--dataloader_type", default="single",
                    choices=["single", "cyclic"])
@@ -280,6 +283,8 @@ def args_to_configs(args, padded_vocab_size: int):
         exit_interval=args.exit_interval,
         exit_duration_in_mins=args.exit_duration_in_mins,
         exit_signal_handler=args.exit_signal_handler,
+        autoresume_file=args.autoresume_file,
+        autoresume_interval=args.autoresume_interval,
         optimizer=args.optimizer,
         lr=args.lr,
         min_lr=args.min_lr,

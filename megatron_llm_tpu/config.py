@@ -217,6 +217,11 @@ class TrainConfig:
     exit_interval: Optional[int] = None
     exit_duration_in_mins: Optional[float] = None
     exit_signal_handler: bool = False
+    # sentinel-file termination hook — the TPU analogue of ADLR autoresume
+    # (ref: --adlr_autoresume arguments.py + utils.py:117-135): when the
+    # file appears, every host checkpoints and exits together.
+    autoresume_file: Optional[str] = None
+    autoresume_interval: int = 50
 
     # Optimizer (ref: arguments.py:666, optimizer/__init__.py:64)
     optimizer: str = "adam"  # adam | sgd

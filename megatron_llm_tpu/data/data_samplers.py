@@ -111,7 +111,8 @@ class PretrainingDataLoader:
     it swappable for a background-thread prefetcher.
     """
 
-    def __init__(self, dataset, sampler, num_microbatches=1, keys=None):
+    def __init__(self, dataset, sampler, num_microbatches=1, keys=None,
+                 row_range=None):
         self.dataset = dataset
         self.sampler = sampler
         # int, or a zero-arg callable consulted each step — that's how the
@@ -122,6 +123,12 @@ class PretrainingDataLoader:
         # every key stacked to (num_micro, mbs*dp, ...) — how the BERT/T5
         # multi-field samples ride the same loader.
         self.keys = keys
+        # multi-host: [lo, hi) slice of each global microbatch this PROCESS
+        # loads (parallel/multihost.process_row_range) — the sampler's
+        # bookkeeping stays global (consumed_samples counts every row),
+        # only the fetch is local, so no host duplicates another's I/O
+        # (ref analogue: per-rank strided samplers, data_samplers.py:48-118)
+        self.row_range = row_range
 
     def __iter__(self):
         it = iter(self.sampler)
@@ -132,6 +139,8 @@ class PretrainingDataLoader:
             try:
                 for _ in range(n):
                     idxs = next(it)
+                    if self.row_range is not None:
+                        idxs = idxs[self.row_range[0]:self.row_range[1]]
                     if self.keys is None:
                         micros.append(np.stack(
                             [self.dataset[i]["text"] for i in idxs]
@@ -163,8 +172,13 @@ def build_pretraining_data_loader(
     dataloader_type: str = "single",
     drop_last: bool = True,
     keys=None,
+    row_range=None,
 ):
-    """ref: build_pretraining_data_loader (data_samplers.py:14-46)."""
+    """ref: build_pretraining_data_loader (data_samplers.py:14-46).
+
+    `row_range`: multi-host [lo, hi) slice of each global microbatch this
+    process loads (see PretrainingDataLoader). Entry points pass
+    `multihost.process_row_range(ctx, mbs*dp)` when process_count > 1."""
     if dataset is None:
         return None
     if dataloader_type == "single":
@@ -185,4 +199,4 @@ def build_pretraining_data_loader(
     else:
         raise ValueError(f"unknown dataloader type {dataloader_type}")
     return PretrainingDataLoader(dataset, sampler, num_microbatches,
-                                 keys=keys)
+                                 keys=keys, row_range=row_range)

@@ -25,11 +25,12 @@ from megatron_llm_tpu.inference.tokenization import (
 )
 
 
-# (model id, mesh) -> jitted pipelined scorer; (model id, mesh, params id)
-# -> stage-replicated param tree. Keyed on ids: a new checkpoint or mesh
-# invalidates naturally, and entries stay tiny (functions / one tree ref).
+# (model id, mesh) -> jitted pipelined scorer. The params cache instead
+# holds a STRONG reference to the source tree and compares identity —
+# keying on id() alone could alias a recycled address after a checkpoint
+# reload and silently serve the old weights.
 _PP_SCORE_CACHE: dict = {}
-_PP_PARAMS_CACHE: dict = {}
+_PP_PARAMS_CACHE: dict = {}  # {"model": .., "mesh": .., "src": .., "out": ..}
 
 
 def _pp_score_fn(model, ctx):
@@ -50,17 +51,18 @@ def _pp_score_fn(model, ctx):
 
 
 def _pp_serving_params(model, ctx, params):
-    key = (id(model), ctx.mesh, id(jax.tree.leaves(params)[0]))
-    if key not in _PP_PARAMS_CACHE:
-        from megatron_llm_tpu.parallel.pipeline import (
-            reshard_params_for_inference,
-        )
+    c = _PP_PARAMS_CACHE
+    if (c.get("model") is model and c.get("mesh") == ctx.mesh
+            and c.get("src") is params):
+        return c["out"]
+    from megatron_llm_tpu.parallel.pipeline import (
+        reshard_params_for_inference,
+    )
 
-        _PP_PARAMS_CACHE.clear()  # one serving tree at a time
-        _PP_PARAMS_CACHE[key] = reshard_params_for_inference(
-            params, ctx, model.cfg
-        )
-    return _PP_PARAMS_CACHE[key]
+    out = reshard_params_for_inference(params, ctx, model.cfg)
+    c.clear()  # one serving tree at a time
+    c.update(model=model, mesh=ctx.mesh, src=params, out=out)
+    return out
 
 
 def generate_and_post_process(
@@ -99,9 +101,15 @@ def generate_and_post_process(
     ctx = get_context()
     if ctx is not None and ctx.pp > 1:
         if tokens_to_generate == 0:
+            import jax.numpy as jnp
+
+            s = tokens.shape[1]
+            pad = (-s) % ctx.cp  # context-sharded seq must divide by cp
+            scored = (jnp.pad(tokens, ((0, 0), (0, pad)))
+                      if pad else tokens)
             lp = np.asarray(
-                _pp_score_fn(model, ctx)(params, tokens[None])[0]
-            )
+                _pp_score_fn(model, ctx)(params, scored[None])[0]
+            )[:, : s - 1]
             texts, segments = detokenize_generations(
                 tokenizer, tokens, lengths, return_segments=True
             )

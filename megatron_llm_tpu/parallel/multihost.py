@@ -1,0 +1,133 @@
+"""Multi-host correctness: per-process data feeding + exit consensus.
+
+Parity targets:
+- ref megatron/data: every rank's sampler loads only its own chunk of the
+  global batch (data_samplers.py:48-118 strided per-rank sampling). The
+  single-controller JAX form: each PROCESS loads only the global-batch
+  rows its addressable devices hold along the `data` axis, then
+  `jax.make_array_from_process_local_data` assembles the global array —
+  no duplicated I/O, no non-addressable transfer errors.
+- ref megatron/dist_signal_handler.py:53-57 — SIGTERM flags are
+  all-gathered so every rank decides to exit together — and
+  training.py:727-739 — the duration check reaches consensus via
+  allreduce(MAX). A pod where one host catches the signal (or crosses the
+  time limit first) must not desync.
+- ref megatron/utils.py:117-135 — ADLR autoresume termination polling;
+  the cluster library has no TPU analogue, so the hook here is a sentinel
+  file any watchdog can touch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.parallel.mesh import DATA_AXIS, ParallelContext
+
+
+# ---------------------------------------------------------------------------
+# Per-process batch rows
+# ---------------------------------------------------------------------------
+
+
+def data_axis_span(dp_indices: Sequence[int], rows: int, dp: int
+                   ) -> Tuple[int, int]:
+    """Pure row-range math: the contiguous [lo, hi) slice of a
+    (rows = mbs*dp)-row global batch owned by the data-axis coordinates
+    `dp_indices`. Global microbatches are assembled rank-chunks-contiguous
+    (data_samplers.py docstring), so coordinate i owns rows
+    [i*mbs, (i+1)*mbs)."""
+    assert rows % dp == 0, (rows, dp)
+    per = rows // dp
+    idx = sorted(set(dp_indices))
+    assert idx, "process holds no data-axis coordinate"
+    assert idx == list(range(idx[0], idx[-1] + 1)), (
+        f"process's data-axis coordinates {idx} are not contiguous; "
+        "reorder the mesh so each host's devices are contiguous on `data`"
+    )
+    return idx[0] * per, (idx[-1] + 1) * per
+
+
+def process_dp_indices(mesh, process_index: Optional[int] = None):
+    """Which `data`-axis coordinates have devices on this process."""
+    pi = jax.process_index() if process_index is None else process_index
+    dev = np.asarray(mesh.devices)
+    dp = dev.shape[0]  # data is the outermost mesh axis
+    return [i for i in range(dp)
+            if any(d.process_index == pi for d in dev[i].flat)]
+
+
+def process_row_range(ctx: ParallelContext, rows: int) -> Tuple[int, int]:
+    """[lo, hi) rows of each global microbatch this process must load."""
+    if jax.process_count() == 1:
+        return 0, rows
+    return data_axis_span(process_dp_indices(ctx.mesh), rows, ctx.dp)
+
+
+def globalize_batch(batch, ctx: ParallelContext, row_axis: int = 1):
+    """Per-process batch leaves with rows (the `data`-sharded dim) at
+    `row_axis` -> global jax.Arrays sharded over `data` on that axis.
+    Identity on single-process runs (GSPMD places host numpy directly).
+    Train batches are (num_micro, rows, ...) [row_axis=1]; eval
+    microbatches are (rows, ...) [row_axis=0]."""
+    if jax.process_count() == 1:
+        return batch
+
+    def glob(x):
+        spec = [None] * x.ndim
+        spec[row_axis] = DATA_AXIS
+        return jax.make_array_from_process_local_data(
+            NamedSharding(ctx.mesh, P(*spec)), np.asarray(x)
+        )
+
+    return jax.tree.map(glob, batch)
+
+
+# ---------------------------------------------------------------------------
+# Exit consensus (ref: dist_signal_handler.py:53-57, training.py:727-739)
+# ---------------------------------------------------------------------------
+
+
+def all_hosts_any(flag: bool) -> bool:
+    """True on EVERY process iff ANY process passed True — the allgather/
+    allreduce-MAX consensus the reference uses for signal and duration
+    exits. Single-process: the flag itself."""
+    if jax.process_count() == 1:
+        return bool(flag)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if flag else 0], np.int32)
+    )
+    return bool(np.max(flags) > 0)
+
+
+class AutoResume:
+    """Sentinel-file termination hook (TPU analogue of ADLR autoresume,
+    ref: utils.py:117-135 + training.py:712-725): when `path` exists (a
+    cluster watchdog touches it before preemption), every host agrees to
+    checkpoint and exit; the file is removed by the first host so the
+    relaunched job doesn't immediately re-exit."""
+
+    def __init__(self, path: str, check_interval: int = 50):
+        self.path = path
+        self.check_interval = max(1, check_interval)
+
+    def termination_requested(self, iteration: int) -> bool:
+        if iteration % self.check_interval != 0:
+            return False
+        local = os.path.exists(self.path)
+        hit = all_hosts_any(local)
+        # EVERY process that can see the file removes it (hosts may not
+        # share a filesystem; first remove wins, the rest tolerate ENOENT)
+        # so the relaunched job doesn't immediately re-exit
+        if hit and local:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+        return hit

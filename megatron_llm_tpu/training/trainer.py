@@ -155,6 +155,12 @@ class Trainer:
         self.signal_handler = (
             SignalHandler() if tcfg.exit_signal_handler else None
         )
+        self._autoresume = None
+        if tcfg.autoresume_file:
+            from megatron_llm_tpu.parallel.multihost import AutoResume
+
+            self._autoresume = AutoResume(tcfg.autoresume_file,
+                                          tcfg.autoresume_interval)
         self._train_steps: dict = {}  # num_microbatches -> jitted step
         self._tb_writer = None
         if tcfg.tensorboard_dir:
@@ -277,6 +283,12 @@ class Trainer:
                 self.reset_attention_mask, self.eod_mask_loss,
             )
         lr, wd = self.scheduler.get_lr(), self.scheduler.get_wd()
+        if self.ctx is not None and jax.process_count() > 1:
+            # per-process rows -> global arrays sharded over `data`
+            # (ref analogue: each rank's sampler loads only its chunk)
+            from megatron_llm_tpu.parallel.multihost import globalize_batch
+
+            batch = globalize_batch(batch, self.ctx)
         step_fn = self._get_step_fn(num_micro)
         params, opt_state, stats = step_fn(
             state.params, state.opt_state, batch,
@@ -330,14 +342,24 @@ class Trainer:
             except StopIteration:
                 break
             if self.batch_builder is not None:
-                total += float(eval_step(state.params,
-                                         self.batch_builder(text)))
+                batch = self.batch_builder(text)
             else:
-                batch = get_batch(text, self.eod_token)
-                micro = jax.tree.map(
-                    lambda x: x.reshape((-1,) + x.shape[2:]), batch
+                raw = get_batch(text, self.eod_token)
+                batch = jax.tree.map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), raw
                 )
-                total += float(eval_step(state.params, micro))
+            if self.ctx is not None and jax.process_count() > 1:
+                from megatron_llm_tpu.parallel.multihost import (
+                    globalize_batch,
+                )
+
+                # batch_builder batches keep the micro axis (rows at 1);
+                # the GPT eval path flattened it (rows at 0)
+                batch = globalize_batch(
+                    batch, self.ctx,
+                    row_axis=1 if self.batch_builder is not None else 0,
+                )
+            total += float(eval_step(state.params, batch))
             count += 1
         return total / max(count, 1)
 
@@ -467,16 +489,31 @@ class Trainer:
             if tcfg.save_interval and state.iteration % tcfg.save_interval == 0:
                 self._save(state)
 
-            # exit conditions (ref: training.py:712-748)
-            if self.signal_handler is not None and self.signal_handler.signals_received():
-                print("exiting on termination signal", flush=True)
-                self._save(state)
-                break
+            # exit conditions (ref: training.py:712-748). Signal/duration
+            # decisions are a CONSENSUS across hosts (allgather-MAX, ref:
+            # dist_signal_handler.py:53-57, training.py:727-739) so a pod
+            # where one host catches SIGTERM or crosses the limit first
+            # exits together.
+            from megatron_llm_tpu.parallel.multihost import all_hosts_any
+
+            if self.signal_handler is not None:
+                if all_hosts_any(self.signal_handler.signals_received()):
+                    print("exiting on termination signal", flush=True)
+                    self._save(state)
+                    break
             if tcfg.exit_duration_in_mins is not None:
-                if (time.time() - start_time) / 60.0 > tcfg.exit_duration_in_mins:
+                over = (time.time() - start_time) / 60.0 \
+                    > tcfg.exit_duration_in_mins
+                if all_hosts_any(over):
                     print("exiting on duration limit", flush=True)
                     self._save(state)
                     break
+            if self._autoresume is not None and \
+                    self._autoresume.termination_requested(state.iteration):
+                print("exiting on autoresume termination request",
+                      flush=True)
+                self._save(state)
+                break
             if tcfg.exit_interval and state.iteration % tcfg.exit_interval == 0:
                 print(f"exiting at iteration {state.iteration}", flush=True)
                 break
@@ -521,15 +558,27 @@ def pretrain(
     )
     state = trainer.setup()
 
+    # multi-host: each process loads only its data-axis rows of every
+    # global microbatch (parallel/multihost.py)
+    row_range = None
+    if trainer.ctx is not None and jax.process_count() > 1:
+        from megatron_llm_tpu.parallel.multihost import process_row_range
+
+        row_range = process_row_range(
+            trainer.ctx, tcfg.micro_batch_size * pcfg.data_parallel_size
+        )
+
     # the trainer's calculator is the single source of the current batch
     # size; the loader consults it live so --rampup_batch_size ramps
     # (ref: training.py:403 re-reads get_num_microbatches() every step)
     trainer.train_data_iterator = build_pretraining_data_loader(
         train_ds, state.consumed_train_samples, tcfg.micro_batch_size,
         pcfg.data_parallel_size, trainer.num_microbatches_calc.get,
+        row_range=row_range,
     )
     trainer.valid_data_iterator = build_pretraining_data_loader(
         valid_ds, 0, tcfg.micro_batch_size, pcfg.data_parallel_size, 1,
+        row_range=row_range,
     )
 
     state = trainer.train(state)

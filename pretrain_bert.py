@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.arguments import args_to_configs, build_base_parser
@@ -102,14 +103,24 @@ def main(argv=None):
     )
     trainer = Trainer(model, tcfg, pcfg, batch_builder=get_batch)
     state = trainer.setup()
+    # multi-host: each process loads only its data-axis rows
+    row_range = None
+    if trainer.ctx is not None and jax.process_count() > 1:
+        from megatron_llm_tpu.parallel.multihost import process_row_range
+
+        row_range = process_row_range(
+            trainer.ctx, tcfg.micro_batch_size * pcfg.data_parallel_size
+        )
     trainer.train_data_iterator = build_pretraining_data_loader(
         train_ds, state.consumed_train_samples, tcfg.micro_batch_size,
         pcfg.data_parallel_size, trainer.num_microbatches_calc.get,
         keys=BERT_KEYS,
+        row_range=row_range,
     )
     trainer.valid_data_iterator = build_pretraining_data_loader(
         valid_ds, 0, tcfg.micro_batch_size, pcfg.data_parallel_size, 1,
         keys=BERT_KEYS,
+        row_range=row_range,
     )
     state = trainer.train(state)
     if tcfg.save:

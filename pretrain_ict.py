@@ -13,6 +13,7 @@ evidence block, in-batch negatives everywhere else.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.arguments import args_to_configs, build_base_parser
@@ -105,10 +106,19 @@ def main(argv=None):
     )
     trainer = Trainer(model, tcfg, pcfg, batch_builder=get_batch)
     state = trainer.setup()
+    # multi-host: each process loads only its data-axis rows
+    row_range = None
+    if trainer.ctx is not None and jax.process_count() > 1:
+        from megatron_llm_tpu.parallel.multihost import process_row_range
+
+        row_range = process_row_range(
+            trainer.ctx, tcfg.micro_batch_size * pcfg.data_parallel_size
+        )
     trainer.train_data_iterator = build_pretraining_data_loader(
         train_ds, state.consumed_train_samples, tcfg.micro_batch_size,
         pcfg.data_parallel_size, trainer.num_microbatches_calc.get,
         keys=ICT_KEYS,
+        row_range=row_range,
     )
     state = trainer.train(state)
     if tcfg.save:

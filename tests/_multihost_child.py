@@ -1,0 +1,78 @@
+"""Child process for tests/test_multihost.py's 2-process distributed test.
+
+Usage: python _multihost_child.py <process_id> <coordinator_port>
+Each process: 4 virtual CPU devices (8 global), mesh dp=4/tp=2, loads ONLY
+its own rows of the deterministic global batch, and the Trainer globalizes
+them with make_array_from_process_local_data. Prints `LOSS <v> GNORM <v>`
+(must match across processes AND the parent's single-device run) and
+exercises the exit-consensus helper.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1])
+port = int(sys.argv[2])
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+
+from megatron_llm_tpu.config import (  # noqa: E402
+    ParallelConfig,
+    TrainConfig,
+    tiny_config,
+)
+from megatron_llm_tpu.models import LlamaModel  # noqa: E402
+from megatron_llm_tpu.parallel.mesh import initialize_parallel  # noqa: E402
+from megatron_llm_tpu.parallel.multihost import (  # noqa: E402
+    all_hosts_any,
+    process_row_range,
+)
+from megatron_llm_tpu.training.trainer import Trainer  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+cfg = tiny_config(
+    num_layers=2, hidden_size=64, num_attention_heads=8,
+    num_attention_heads_kv=2, ffn_hidden_size=128, seq_length=32,
+    max_position_embeddings=32, padded_vocab_size=256,
+    compute_dtype=np.float32, params_dtype=np.float32,
+)
+num_micro, mbs, dp = 2, 2, 4
+ctx = initialize_parallel(dp=dp, pp=1, tp=2)
+pcfg = ParallelConfig(data_parallel_size=dp, tensor_parallel_size=2,
+                      num_microbatches=num_micro)
+tcfg = TrainConfig(micro_batch_size=mbs, global_batch_size=num_micro * mbs * dp,
+                   lr=1e-4, train_iters=1)
+
+rows = mbs * dp
+lo, hi = process_row_range(ctx, rows)
+assert (hi - lo) == rows // 2, (lo, hi)
+# the two processes must cover disjoint halves
+print(f"ROWS {pid} {lo} {hi}", flush=True)
+
+# deterministic GLOBAL batch; each process slices ITS rows only (the same
+# thing the row_range loader does)
+text_global = np.random.RandomState(0).randint(
+    0, 256, (num_micro, rows, cfg.seq_length + 1)
+).astype(np.int32)
+text_local = text_global[:, lo:hi]
+
+trainer = Trainer(LlamaModel(cfg), tcfg, pcfg)
+state = trainer.setup()
+stats = trainer.train_step(state, text_local)
+print(f"LOSS {float(stats['loss']):.8f} GNORM "
+      f"{float(stats['grad_norm']):.8f}", flush=True)
+
+# exit consensus: flag raised on process 1 only -> True EVERYWHERE;
+# no flag -> False everywhere
+assert all_hosts_any(pid == 1) is True
+assert all_hosts_any(False) is False
+print("CONSENSUS OK", flush=True)

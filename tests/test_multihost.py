@@ -1,0 +1,177 @@
+"""Multi-host data feeding + exit consensus (VERDICT r3 weak #6 / next #8).
+
+- pure shard-assembly math: `data_axis_span` row ranges per process;
+- the loader's `row_range` slicing (each process fetches only its rows);
+- `all_hosts_any` / AutoResume single-process semantics;
+- THE REAL THING (slow): two jax.distributed CPU processes (4 virtual
+  devices each, 8 global, mesh dp=4/tp=2) each load only their half of a
+  deterministic global batch, run the production Trainer step through
+  `make_array_from_process_local_data`, and must produce the SAME loss —
+  equal to the parent's single-device run on the full batch — plus
+  exit-consensus agreement (ref: dist_signal_handler.py:53-57).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.parallel.multihost import (
+    AutoResume,
+    all_hosts_any,
+    data_axis_span,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestRowMath:
+    def test_contiguous_spans(self):
+        assert data_axis_span([0, 1], 16, 4) == (0, 8)
+        assert data_axis_span([2, 3], 16, 4) == (8, 16)
+        assert data_axis_span([1], 12, 4) == (3, 6)
+        assert data_axis_span([0, 1, 2, 3], 8, 4) == (0, 8)
+
+    def test_non_contiguous_rejected(self):
+        with pytest.raises(AssertionError):
+            data_axis_span([0, 2], 16, 4)
+
+    def test_indivisible_rows_rejected(self):
+        with pytest.raises(AssertionError):
+            data_axis_span([0], 10, 4)
+
+    def test_single_process_full_range(self):
+        from megatron_llm_tpu.parallel.mesh import (
+            destroy_parallel,
+            initialize_parallel,
+        )
+        from megatron_llm_tpu.parallel.multihost import process_row_range
+
+        ctx = initialize_parallel(dp=4, pp=1, tp=2)
+        try:
+            assert process_row_range(ctx, 16) == (0, 16)
+        finally:
+            destroy_parallel()
+
+
+class TestLoaderRowRange:
+    def test_loader_fetches_only_local_rows(self):
+        from megatron_llm_tpu.data.data_samplers import (
+            build_pretraining_data_loader,
+        )
+
+        fetched = []
+
+        class DS:
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                fetched.append(i)
+                return {"text": np.full((9,), i, np.int32)}
+
+        loader = build_pretraining_data_loader(
+            DS(), 0, micro_batch_size=2, data_parallel_size=4,
+            num_microbatches=2, row_range=(2, 6),
+        )
+        batch = next(iter(loader))
+        # global microbatch rows are 8; this process holds rows 2..5
+        assert batch.shape == (2, 4, 9)
+        assert fetched == [2, 3, 4, 5, 10, 11, 12, 13]
+        assert batch[0, 0, 0] == 2 and batch[1, 0, 0] == 10
+
+
+class TestConsensusSingleProcess:
+    def test_all_hosts_any_is_identity(self):
+        assert all_hosts_any(True) is True
+        assert all_hosts_any(False) is False
+
+    def test_autoresume_sentinel(self, tmp_path):
+        sentinel = str(tmp_path / "terminate")
+        ar = AutoResume(sentinel, check_interval=10)
+        assert not ar.termination_requested(10)
+        open(sentinel, "w").close()
+        assert not ar.termination_requested(11)  # off-interval: no check
+        assert ar.termination_requested(20)
+        assert not os.path.exists(sentinel)  # consumed
+        assert not ar.termination_requested(30)
+
+
+@pytest.mark.slow
+class TestTwoProcessDistributed:
+    def test_train_step_parity_and_consensus(self):
+        # parent: single-device reference loss on the full global batch
+        import jax
+
+        jax.config.update("jax_default_matmul_precision", "highest")
+        import numpy as np
+
+        from megatron_llm_tpu.config import (
+            ParallelConfig,
+            TrainConfig,
+            tiny_config,
+        )
+        from megatron_llm_tpu.models import LlamaModel
+        from megatron_llm_tpu.parallel.mesh import destroy_parallel
+        from megatron_llm_tpu.training.trainer import Trainer
+
+        destroy_parallel()
+        cfg = tiny_config(
+            num_layers=2, hidden_size=64, num_attention_heads=8,
+            num_attention_heads_kv=2, ffn_hidden_size=128, seq_length=32,
+            max_position_embeddings=32, padded_vocab_size=256,
+            compute_dtype=np.float32, params_dtype=np.float32,
+        )
+        num_micro, mbs, dp = 2, 2, 4
+        text = np.random.RandomState(0).randint(
+            0, 256, (num_micro, mbs * dp, cfg.seq_length + 1)
+        ).astype(np.int32)
+        tcfg = TrainConfig(micro_batch_size=mbs * dp,
+                           global_batch_size=num_micro * mbs * dp,
+                           lr=1e-4, train_iters=1)
+        base = Trainer(LlamaModel(cfg), tcfg,
+                       ParallelConfig(num_microbatches=num_micro))
+        ref = base.train_step(base.setup(), text)
+        ref_loss = float(ref["loss"])
+
+        # children: 2 distributed processes, 4 virtual CPU devices each
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        child = os.path.join(_REPO, "tests", "_multihost_child.py")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, child, str(pid), str(port)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=_REPO,
+            )
+            for pid in (0, 1)
+        ]
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out[-3000:]
+
+        spans = {}
+        losses = []
+        for out in outs:
+            assert "CONSENSUS OK" in out, out[-3000:]
+            for line in out.splitlines():
+                if line.startswith("ROWS"):
+                    _, pid, lo, hi = line.split()
+                    spans[int(pid)] = (int(lo), int(hi))
+                if line.startswith("LOSS"):
+                    losses.append(float(line.split()[1]))
+        # disjoint halves covering all rows
+        assert sorted(spans.values()) == [(0, 4), (4, 8)], spans
+        # both processes computed the SAME loss == single-device loss
+        assert len(losses) == 2
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+        np.testing.assert_allclose(losses[0], ref_loss, rtol=2e-4)

@@ -304,9 +304,16 @@ def native2megatron(args) -> None:
         jax.tree.map(ocp.utils.to_shape_dtype_struct, tmpl),
     )
     lm = native_to_reference(params, cfg)
-    out = save_reference_checkpoint(
-        args.output, lm, reference_args_for_cfg(cfg),
-    )
+    ref_args = reference_args_for_cfg(cfg)
+    # non-architecture scalars (seq_length, ...) come from the checkpoint's
+    # meta, not the placeholder config the arch fields were overlaid on
+    with open(os.path.join(path, "meta.json")) as f:
+        saved = json.load(f).get("config", {})
+    for k in ref_args:
+        if k in saved and isinstance(saved[k],
+                                     (int, float, bool, str, type(None))):
+            ref_args[k] = saved[k]
+    out = save_reference_checkpoint(args.output, lm, ref_args)
     print(f"wrote reference-megatron checkpoint to {out}", flush=True)
 
 
