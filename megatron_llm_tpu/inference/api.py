@@ -51,17 +51,28 @@ def _pp_score_fn(model, ctx):
 
 
 def _pp_serving_params(model, ctx, params):
+    import weakref
+
+    leaf = jax.tree.leaves(params)[0]
     c = _PP_PARAMS_CACHE
     if (c.get("model") is model and c.get("mesh") == ctx.mesh
-            and c.get("src") is params):
+            and c.get("src_ref") is not None
+            and c["src_ref"]() is leaf):
         return c["out"]
     from megatron_llm_tpu.parallel.pipeline import (
         reshard_params_for_inference,
     )
 
     out = reshard_params_for_inference(params, ctx, model.cfg)
+    # weakref to one leaf: identity check without pinning the whole stale
+    # source tree in memory after a checkpoint reload (jax.Array leaves
+    # are weakref-able; a dead ref simply misses the cache)
+    try:
+        src_ref = weakref.ref(leaf)
+    except TypeError:
+        src_ref = None
     c.clear()  # one serving tree at a time
-    c.update(model=model, mesh=ctx.mesh, src=params, out=out)
+    c.update(model=model, mesh=ctx.mesh, src_ref=src_ref, out=out)
     return out
 
 

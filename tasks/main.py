@@ -35,7 +35,9 @@ def get_tasks_args(parser):
     group that belongs to the REALM stack."""
     g = parser.add_argument_group("tasks")
     g.add_argument("--task", type=str, required=True,
-                   choices=["WIKITEXT103", "LAMBADA", "MNLI", "QQP", "RACE"])
+                   choices=["WIKITEXT103", "LAMBADA", "MNLI", "QQP", "RACE",
+                            "MSDP-PROMPT", "MSDP-EVAL-F1",
+                            "RETRIEVER-EVAL", "ICT-ZEROSHOT-NQ"])
     g.add_argument("--train_data", nargs="+", default=None)
     g.add_argument("--valid_data", nargs="*", default=None)
     g.add_argument("--overlapping_eval", type=int, default=32)
@@ -43,6 +45,27 @@ def get_tasks_args(parser):
     g.add_argument("--eval_micro_batch_size", type=int, default=None)
     g.add_argument("--epochs", type=int, default=3)
     g.add_argument("--pretrained_checkpoint", type=str, default=None)
+    # MSDP (ref: tasks/msdp/main.py get_tasks_args)
+    g.add_argument("--sample_input_file", type=str, default=None)
+    g.add_argument("--sample_output_file", type=str, default=None)
+    g.add_argument("--prompt_file", type=str, default=None)
+    g.add_argument("--prompt_type", type=str, default=None,
+                   choices=[None, "knowledge", "response"])
+    g.add_argument("--num_prompt_examples", type=int, default=10)
+    g.add_argument("--guess_file", type=str, default=None)
+    g.add_argument("--answer_file", type=str, default=None)
+    g.add_argument("--out_seq_length", type=int, default=100)
+    # ORQA retriever eval (ref: tasks/main.py:56-72 + orqa args)
+    g.add_argument("--qa_data_dev", type=str, default=None)
+    g.add_argument("--qa_data_test", type=str, default=None)
+    g.add_argument("--evidence_data_path", type=str, default=None)
+    g.add_argument("--retriever_seq_length", type=int, default=256)
+    g.add_argument("--retriever_topk", type=int, default=20)
+    g.add_argument("--match", type=str, default="string",
+                   choices=["string", "regex"])
+    g.add_argument("--biencoder_shared_query_context_model",
+                   action="store_true")
+    g.add_argument("--biencoder_projection_dim", type=int, default=0)
     return parser
 
 
@@ -155,6 +178,67 @@ def _finetune_main(args):
         print(f"saved finetuned weights to {args.save}", flush=True)
 
 
+def _retriever_eval_main(args):
+    """Biencoder retriever accuracy on NQ (ref: tasks/orqa/evaluate_orqa.py
+    + evaluate_utils.py): embed the evidence TSV with the context tower,
+    embed the questions with the query tower, MIPS on-device, report
+    top-k answer-containment accuracy."""
+    import dataclasses
+
+    from megatron_llm_tpu.arguments import args_to_configs
+    from megatron_llm_tpu.models.biencoder import BiEncoderModel
+    from megatron_llm_tpu.parallel import initialize_parallel
+    from megatron_llm_tpu.tokenizer import build_tokenizer
+    from megatron_llm_tpu.training.checkpointing import load_checkpoint
+
+    from tasks.orqa.evaluate import ORQAEvaluator, read_evidence_tsv
+
+    assert args.evidence_data_path, "--evidence_data_path is required"
+    assert args.qa_data_dev or args.qa_data_test, (
+        "--qa_data_dev and/or --qa_data_test is required"
+    )
+    tokenizer = build_tokenizer(
+        args.tokenizer_type or "BertWordPieceLowerCase",
+        vocab_file=args.vocab_file,
+        make_vocab_size_divisible_by=args.make_vocab_size_divisible_by,
+        tensor_parallel_size=args.tensor_model_parallel_size,
+    )
+    args.model_name = "bert"
+    mcfg, pcfg, tcfg, _ = args_to_configs(args, tokenizer.vocab_size)
+    mcfg = dataclasses.replace(mcfg, add_binary_head=False)
+    initialize_parallel(dp=pcfg.data_parallel_size, pp=1,
+                        tp=pcfg.tensor_parallel_size)
+
+    model = BiEncoderModel(
+        mcfg,
+        projection_dim=args.biencoder_projection_dim,
+        shared_query_context_model=args.biencoder_shared_query_context_model,
+    )
+    params = model.init(jax.random.key(tcfg.seed))
+    if args.load:
+        restored = load_checkpoint(args.load, params, no_load_optim=True,
+                                   finetune=True)
+        assert restored is not None, f"no checkpoint found in {args.load}"
+        params = restored[0]
+
+    evaluator = ORQAEvaluator(
+        model, params, tokenizer,
+        seq_length=args.retriever_seq_length,
+        batch_size=args.micro_batch_size,
+    )
+    docs = read_evidence_tsv(args.evidence_data_path)
+    print(f" > embedding {len(docs)} evidence blocks ...", flush=True)
+    evaluator.build_index(docs)
+    if args.qa_data_dev:
+        evaluator.evaluate(args.qa_data_dev, "DEV",
+                           topk=args.retriever_topk,
+                           match_type=args.match)
+    if args.qa_data_test:
+        evaluator.evaluate(args.qa_data_test, "TEST",
+                           topk=args.retriever_topk,
+                           match_type=args.match)
+
+
 def main(argv=None):
     from megatron_llm_tpu.arguments import args_to_configs, build_base_parser
     from megatron_llm_tpu.parallel import initialize_parallel
@@ -171,8 +255,29 @@ def main(argv=None):
         _finetune_main(args)
         print("done :-)")
         return
-    assert args.valid_data and len(args.valid_data) == 1, \
-        "--valid_data takes exactly one path"
+    if args.task == "MSDP-EVAL-F1":
+        # pure file-vs-file metric, no model (ref: tasks/msdp/evaluate.py)
+        assert args.guess_file and args.answer_file, (
+            "MSDP-EVAL-F1 needs --guess_file and --answer_file"
+        )
+        from tasks.msdp.evaluate import main as msdp_eval_main
+
+        msdp_eval_main(args)
+        print("done :-)")
+        return
+    if args.task in ("RETRIEVER-EVAL", "ICT-ZEROSHOT-NQ"):
+        _retriever_eval_main(args)
+        print("done :-)")
+        return
+    if args.task == "MSDP-PROMPT":
+        assert args.sample_input_file and args.sample_output_file \
+            and args.prompt_file and args.prompt_type, (
+                "MSDP-PROMPT needs --sample_input_file, "
+                "--sample_output_file, --prompt_file, --prompt_type"
+            )
+    else:
+        assert args.valid_data and len(args.valid_data) == 1, \
+            "--valid_data takes exactly one path"
 
     tokenizer = build_tokenizer(
         args.tokenizer_type or "NullTokenizer",
@@ -200,6 +305,14 @@ def main(argv=None):
                                    no_load_optim=True)
         assert restored is not None, f"no checkpoint found in {args.load}"
         params = restored[0]
+
+    if args.task == "MSDP-PROMPT":
+        from tasks.msdp.prompt import main as msdp_prompt_main
+
+        msdp_prompt_main(args, model=model, params=params,
+                         tokenizer=tokenizer)
+        print("done :-)")
+        return
 
     data = build_dataset(
         args.task, args.valid_data[0], tokenizer, mcfg.seq_length,

@@ -1,0 +1,123 @@
+"""ORQA retriever evaluation: embed evidence + queries, MIPS, top-k hits.
+
+Parity target: ref tasks/orqa/evaluate_orqa.py + evaluate_utils.py
+(ORQAEvaluator) + megatron/indexer.py. The reference pipeline: an
+IndexBuilder embeds every evidence block with the biencoder's context
+tower into a FAISS index; queries embed with the query tower; FAISS MIPS
+returns top-k; `calculate_matches` scores answer containment.
+
+TPU-first design: maximum-inner-product search over a few million
+d-dim embeddings IS a (Q, d) x (d, N) matmul + lax.top_k — exactly what
+the MXU is for. The evidence matrix is embedded in jitted batches and the
+search runs as one chunked device matmul; FAISS (approximate, CPU/GPU) is
+deliberately not a dependency.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tasks.orqa.nq import read_nq_file, tokenize_queries
+from tasks.orqa.qa_utils import calculate_matches
+
+
+def read_evidence_tsv(path: str) -> List[Tuple[object, str, str]]:
+    """The DPR/ref psgs_w100.tsv format: `id \\t text \\t title` with a
+    header row (ref: megatron/data/orqa_wiki_dataset.py)."""
+    import csv
+
+    docs = []
+    with open(path, newline="") as f:
+        reader = csv.reader(f, delimiter="\t")
+        for i, row in enumerate(reader):
+            if i == 0 and row and row[0] == "id":
+                continue
+            if len(row) < 3:
+                continue
+            docs.append((row[0], row[1], row[2]))
+    return docs
+
+
+class ORQAEvaluator:
+    """ref: evaluate_utils.py ORQAEvaluator."""
+
+    def __init__(self, model, params, tokenizer, seq_length: int = 64,
+                 batch_size: int = 32):
+        self.model = model  # BiEncoderModel
+        self.params = params
+        self.tokenizer = tokenizer
+        self.seq_length = seq_length
+        self.batch_size = batch_size
+        self._embed = jax.jit(
+            lambda tower, toks, mask: model.embed_text(tower, toks, mask),
+            static_argnums=(),
+        )
+        self.evidence_ids: Optional[list] = None
+        self.evidence_emb: Optional[np.ndarray] = None
+        self.all_docs: dict = {}
+
+    def _tower(self, name):
+        p = self.params
+        return p["shared"] if "shared" in p else p[name]
+
+    def _embed_texts(self, texts: List[str], tower: str) -> np.ndarray:
+        out = []
+        bs = self.batch_size
+        for i in range(0, len(texts), bs):
+            chunk = texts[i:i + bs]
+            pad = bs - len(chunk)  # keep one compiled shape
+            toks, mask, _ = tokenize_queries(
+                self.tokenizer, chunk + [""] * pad, self.seq_length
+            )
+            emb = self._embed(self._tower(tower), jnp.asarray(toks),
+                              jnp.asarray(mask))
+            out.append(np.asarray(emb, np.float32)[: len(chunk)])
+        return np.concatenate(out, axis=0)
+
+    def build_index(self, docs: List[Tuple[object, str, str]]):
+        """Embed evidence blocks with the CONTEXT tower (ref:
+        megatron/indexer.py IndexBuilder.build_and_save_index). `docs` =
+        [(doc_id, text, title)]."""
+        self.evidence_ids = [d[0] for d in docs]
+        self.all_docs = {d[0]: (d[1], d[2]) for d in docs}
+        self.evidence_emb = self._embed_texts(
+            [d[1] for d in docs], "context"
+        )
+        return self.evidence_emb
+
+    def retrieve(self, questions: List[str], topk: int = 20):
+        """MIPS: (Q, d) @ (d, N) + top-k (the FAISS replacement)."""
+        assert self.evidence_emb is not None, "call build_index first"
+        q = self._embed_texts(questions, "query")
+        scores = jnp.asarray(q) @ jnp.asarray(self.evidence_emb).T
+        k = min(topk, scores.shape[-1])
+        top_scores, top_idx = jax.lax.top_k(scores, k)
+        top_idx = np.asarray(top_idx)
+        top_scores = np.asarray(top_scores)
+        return [
+            ([self.evidence_ids[j] for j in top_idx[i]],
+             list(top_scores[i]))
+            for i in range(len(questions))
+        ]
+
+    def evaluate(self, qa_file: str, split: str = "DEV", topk: int = 20,
+                 match_type: str = "string"):
+        """ref: evaluate_utils.py ORQAEvaluator.evaluate — prints and
+        returns the top-k hit rates."""
+        data = read_nq_file(qa_file)
+        questions = [q for q, _ in data]
+        answers = [a for _, a in data]
+        closest = self.retrieve(questions, topk)
+        stats = calculate_matches(self.all_docs, answers, closest,
+                                  match_type)
+        n = len(questions)
+        rates = [hits / n for hits in stats.top_k_hits]
+        for k in (1, 5, 20, 100):
+            if k <= len(rates):
+                print(f"{split} top-{k} accuracy: {rates[k-1]:.4f}",
+                      flush=True)
+        return rates, stats
