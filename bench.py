@@ -65,8 +65,9 @@ def run_train(seq, iters):
     # Full remat is memory-forced at 0.74B on the 16GB chip (live
     # activations need 23G at mbs 8 / seq 1024 without it, measured r1);
     # mbs swept on-chip r4: 12 peaks at seq 1024 (8/10/14/16/24 all
-    # lower), 6 peaks at seq 4096 (7/8 lower, 10+ OOMs the compiler).
-    mbs = 12 if seq == 1024 else 6
+    # lower), 6 peaks at seq 4096 (7/8 lower, 10+ OOMs the compiler),
+    # 3 at seq 8192.
+    mbs = {1024: 12, 4096: 6, 8192: 3}[seq]
     cfg = make_cfg(seq)
     model = LlamaModel(cfg)
     params = model.init(jax.random.key(0))
@@ -143,8 +144,9 @@ def flash_vs_xla_ratio():
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--seq", type=int, default=0, choices=[0, 1024, 4096],
-                   help="0 = both lengths + kernel ratio (the artifact run)")
+    p.add_argument("--seq", type=int, default=0, choices=[0, 1024, 4096, 8192],
+                   help="0 = all three lengths + kernel ratio (the "
+                        "artifact run)")
     p.add_argument("--iters", type=int, default=20)
     args = p.parse_args()
     assert jax.default_backend() == "tpu", jax.default_backend()
@@ -163,6 +165,7 @@ def main():
 
     tok1, mfu1, n_params = run_train(1024, args.iters)
     tok4, mfu4, _ = run_train(4096, args.iters)
+    tok8, mfu8, _ = run_train(8192, max(args.iters // 2, 5))
     ratio = flash_vs_xla_ratio()
     achieved = tok1 * 6 * n_params
     baseline = 890.0 * 6 * 7.0e9  # A100 anchor, BASELINE.md
@@ -172,6 +175,7 @@ def main():
             f"flash-attn(Pallas) ON, full remat, v5e, MFU {mfu1:.1%} "
             f"(FLOP-normalized vs A100 7B anchor); "
             f"seq 4096: {tok4:.0f} tok/s, MFU {mfu4:.1%}; "
+            f"seq 8192: {tok8:.0f} tok/s, MFU {mfu8:.1%}; "
             f"flash-vs-XLA fwd+bwd speedup {ratio:.2f}x"
         ),
         "value": round(tok1, 1),
@@ -181,6 +185,8 @@ def main():
             "mfu_seq1024": round(mfu1, 4),
             "tok_s_seq4096": round(tok4, 1),
             "mfu_seq4096": round(mfu4, 4),
+            "tok_s_seq8192": round(tok8, 1),
+            "mfu_seq8192": round(mfu8, 4),
             "flash_vs_xla_fwd_bwd_speedup": round(ratio, 2),
         },
     }))

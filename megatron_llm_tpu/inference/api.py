@@ -53,26 +53,28 @@ def _pp_score_fn(model, ctx):
 def _pp_serving_params(model, ctx, params):
     import weakref
 
-    leaf = jax.tree.leaves(params)[0]
+    leaves = jax.tree.leaves(params)
     c = _PP_PARAMS_CACHE
+    refs = c.get("src_refs")
     if (c.get("model") is model and c.get("mesh") == ctx.mesh
-            and c.get("src_ref") is not None
-            and c["src_ref"]() is leaf):
+            and refs is not None and len(refs) == len(leaves)
+            and all(r() is l for r, l in zip(refs, leaves))):
         return c["out"]
     from megatron_llm_tpu.parallel.pipeline import (
         reshard_params_for_inference,
     )
 
     out = reshard_params_for_inference(params, ctx, model.cfg)
-    # weakref to one leaf: identity check without pinning the whole stale
-    # source tree in memory after a checkpoint reload (jax.Array leaves
-    # are weakref-able; a dead ref simply misses the cache)
+    # weakrefs to EVERY leaf: identity of the whole tree, without pinning
+    # the stale source in memory after a checkpoint reload (jax.Array
+    # leaves are weakref-able; any dead/changed ref misses the cache —
+    # partial param updates that reuse some leaf objects still miss)
     try:
-        src_ref = weakref.ref(leaf)
+        src_refs = tuple(weakref.ref(l) for l in leaves)
     except TypeError:
-        src_ref = None
+        src_refs = None
     c.clear()  # one serving tree at a time
-    c.update(model=model, mesh=ctx.mesh, src_ref=src_ref, out=out)
+    c.update(model=model, mesh=ctx.mesh, src_refs=src_refs, out=out)
     return out
 
 

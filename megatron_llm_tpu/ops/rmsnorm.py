@@ -1,20 +1,28 @@
-"""Fused RMSNorm — Pallas kernel, fwd + bwd.
+"""Fused RMSNorm — Pallas kernel, fwd + bwd. NOT the default path.
 
 Parity target: ref megatron/model/fused_layer_norm.py:64-139 — the
-reference routes RMSNorm/LayerNorm through apex's fused CUDA kernels; on
-TPU the fused path is this Pallas kernel. One pass over HBM per direction:
-the forward reads x once, computes the fp32 row statistic in VMEM and
-writes the normalized/scaled output plus the per-row rstd; the backward
-recomputes x_hat from the saved rstd and emits dx and a per-row-block
-partial of dscale (summed by XLA outside).
+reference routes RMSNorm/LayerNorm through apex's fused CUDA kernels
+because torch eager would otherwise issue multiple kernels. XLA already
+fuses the whole RMSNorm into its neighbors, so the honest status of this
+kernel (measured in-jit on a v5e, r4, scan-amortized so no dispatch
+overhead): ~PAR with the XLA path — (rows=12k, h=2048) fwd 3.06ms vs
+XLA 2.33ms, (rows=24k) fwd 3.36ms vs 3.49ms / fwd+bwd 2.6ms vs 4.1ms.
+It is kept as the Pallas-toolchain reference + test vector and an
+opt-in (cfg.use_fused_rmsnorm / `use_pallas=True`), NOT wired as a
+default: on TPU there is no apex-shaped win to claim here, and
+models/norms.py + XLA fusion is the production path.
+
+One pass over HBM per direction: the forward reads x once, computes the
+fp32 row statistic in VMEM and writes the normalized/scaled output plus
+the per-row rstd; the backward recomputes x_hat from the saved rstd and
+emits dx and a per-row-block partial of dscale (summed by XLA outside).
 
 Math matches models/norms.rms_norm exactly, including the cast order
 (normalize in fp32, cast to the input dtype, THEN apply the scale —
 ref: fused_layer_norm.py:133-138).
 
-`fused_rms_norm` dispatches to Pallas on TPU (hidden size lane-aligned)
-and to the XLA implementation elsewhere; `interpret=True` runs the real
-kernel through the Pallas interpreter (CPU test suite).
+`interpret=True` runs the real kernel through the Pallas interpreter
+(CPU test suite).
 """
 
 from __future__ import annotations
@@ -26,8 +34,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 DEFAULT_BLOCK_ROWS = 256
-# fp32 row block + fp32 out block + scratch must sit in ~16MB VMEM
-_VMEM_BUDGET = 4 * 1024 * 1024  # floats per block, conservative
+# The backward holds ~4 fp32 row blocks (x, g, u, x_hat) + 2 bf16 blocks
+# live at once; block*h is capped so the worst case stays well under the
+# 16MB VMEM scoped limit (512K floats -> ~10MB worst case).
+_VMEM_BUDGET = 512 * 1024  # floats per block
 
 
 def _choose_rows(n_rows: int, h: int) -> int | None:

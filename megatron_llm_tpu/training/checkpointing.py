@@ -89,6 +89,7 @@ def save_checkpoint(
 ) -> str:
     """ref: save_checkpoint (checkpointing.py:243-338). `release=True`
     writes the converter layout (ref: "release" naming, checkpointing.py:93)."""
+    save_dir = os.path.abspath(save_dir)  # orbax requires absolute paths
     path = checkpoint_dir(save_dir, iteration, release=release)
     os.makedirs(save_dir, exist_ok=True)
     ckptr = ocp.StandardCheckpointer()
@@ -190,6 +191,7 @@ def load_checkpoint(
     same checkpoint loads under any mesh. Returns
     (params, opt_state|None, meta, iteration).
     """
+    load_dir = os.path.abspath(load_dir)  # orbax requires absolute paths
     release = False
     if iteration is None:
         iteration, release = read_tracker(load_dir)

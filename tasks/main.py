@@ -37,7 +37,8 @@ def get_tasks_args(parser):
     g.add_argument("--task", type=str, required=True,
                    choices=["WIKITEXT103", "LAMBADA", "MNLI", "QQP", "RACE",
                             "MSDP-PROMPT", "MSDP-EVAL-F1",
-                            "RETRIEVER-EVAL", "ICT-ZEROSHOT-NQ"])
+                            "RETRIEVER-EVAL", "ICT-ZEROSHOT-NQ",
+                            "RET-FINETUNE-NQ"])
     g.add_argument("--train_data", nargs="+", default=None)
     g.add_argument("--valid_data", nargs="*", default=None)
     g.add_argument("--overlapping_eval", type=int, default=32)
@@ -66,6 +67,7 @@ def get_tasks_args(parser):
     g.add_argument("--biencoder_shared_query_context_model",
                    action="store_true")
     g.add_argument("--biencoder_projection_dim", type=int, default=0)
+    g.add_argument("--use_hard_negatives", action="store_true")
     return parser
 
 
@@ -239,6 +241,73 @@ def _retriever_eval_main(args):
                            match_type=args.match)
 
 
+def _retriever_finetune_main(args):
+    """Supervised biencoder finetuning on DPR-format NQ
+    (ref: tasks/orqa/supervised/finetune.py, RET-FINETUNE-NQ)."""
+    import dataclasses
+
+    from megatron_llm_tpu.arguments import args_to_configs
+    from megatron_llm_tpu.models.biencoder import BiEncoderModel
+    from megatron_llm_tpu.parallel import initialize_parallel
+    from megatron_llm_tpu.tokenizer import build_tokenizer
+    from megatron_llm_tpu.training.checkpointing import load_checkpoint
+
+    from tasks.orqa.supervised import (
+        OpenRetrievalDataset,
+        finetune_retriever,
+    )
+
+    assert args.train_data, "--train_data (DPR-format json) is required"
+    tokenizer = build_tokenizer(
+        args.tokenizer_type or "BertWordPieceLowerCase",
+        vocab_file=args.vocab_file,
+        make_vocab_size_divisible_by=args.make_vocab_size_divisible_by,
+        tensor_parallel_size=args.tensor_model_parallel_size,
+    )
+    args.model_name = "bert"
+    mcfg, pcfg, tcfg, _ = args_to_configs(args, tokenizer.vocab_size)
+    mcfg = dataclasses.replace(mcfg, add_binary_head=False)
+    initialize_parallel(dp=pcfg.data_parallel_size, pp=1,
+                        tp=pcfg.tensor_parallel_size)
+
+    model = BiEncoderModel(
+        mcfg,
+        projection_dim=args.biencoder_projection_dim,
+        shared_query_context_model=args.biencoder_shared_query_context_model,
+    )
+    params = model.init(jax.random.key(tcfg.seed))
+    if not args.pretrained_checkpoint and args.load:
+        args.pretrained_checkpoint = args.load
+    if args.pretrained_checkpoint:
+        restored = load_checkpoint(args.pretrained_checkpoint, params,
+                                   no_load_optim=True, finetune=True)
+        assert restored is not None, (
+            f"no checkpoint in {args.pretrained_checkpoint}"
+        )
+        params = restored[0]
+
+    train_ds = OpenRetrievalDataset(
+        args.train_data[0], tokenizer,
+        max_seq_length=args.retriever_seq_length,
+        use_hard_negatives=args.use_hard_negatives, seed=tcfg.seed,
+    )
+    valid_ds = (OpenRetrievalDataset(
+        args.valid_data[0], tokenizer,
+        max_seq_length=args.retriever_seq_length, seed=tcfg.seed)
+        if args.valid_data else None)
+    params = finetune_retriever(
+        model, params, train_ds, valid_ds, epochs=args.epochs,
+        batch_size=args.micro_batch_size, lr=tcfg.lr,
+        use_hard_negatives=args.use_hard_negatives, seed=tcfg.seed,
+        log_interval=args.log_interval,
+    )
+    if args.save:
+        from megatron_llm_tpu.training.checkpointing import save_checkpoint
+
+        save_checkpoint(args.save, 0, params, None, mcfg)
+        print(f"saved finetuned retriever to {args.save}", flush=True)
+
+
 def main(argv=None):
     from megatron_llm_tpu.arguments import args_to_configs, build_base_parser
     from megatron_llm_tpu.parallel import initialize_parallel
@@ -267,6 +336,10 @@ def main(argv=None):
         return
     if args.task in ("RETRIEVER-EVAL", "ICT-ZEROSHOT-NQ"):
         _retriever_eval_main(args)
+        print("done :-)")
+        return
+    if args.task == "RET-FINETUNE-NQ":
+        _retriever_finetune_main(args)
         print("done :-)")
         return
     if args.task == "MSDP-PROMPT":

@@ -9,7 +9,7 @@ returns top-k; `calculate_matches` scores answer containment.
 TPU-first design: maximum-inner-product search over a few million
 d-dim embeddings IS a (Q, d) x (d, N) matmul + lax.top_k — exactly what
 the MXU is for. The evidence matrix is embedded in jitted batches and the
-search runs as one chunked device matmul; FAISS (approximate, CPU/GPU) is
+search runs as a chunked device matmul with a running top-k merge; FAISS (approximate, CPU/GPU) is
 deliberately not a dependency.
 """
 
@@ -89,18 +89,38 @@ class ORQAEvaluator:
         )
         return self.evidence_emb
 
-    def retrieve(self, questions: List[str], topk: int = 20):
-        """MIPS: (Q, d) @ (d, N) + top-k (the FAISS replacement)."""
+    def retrieve(self, questions: List[str], topk: int = 20,
+                 chunk_rows: int = 1 << 20):
+        """MIPS: (Q, d) @ (d, N) + top-k (the FAISS replacement),
+        CHUNKED over the evidence axis with a running top-k merge so the
+        (Q, N) score matrix never materializes and each device transfer
+        is one <=chunk_rows slice of the host-resident evidence matrix."""
         assert self.evidence_emb is not None, "call build_index first"
-        q = self._embed_texts(questions, "query")
-        scores = jnp.asarray(q) @ jnp.asarray(self.evidence_emb).T
-        k = min(topk, scores.shape[-1])
-        top_scores, top_idx = jax.lax.top_k(scores, k)
-        top_idx = np.asarray(top_idx)
-        top_scores = np.asarray(top_scores)
+        q = jnp.asarray(self._embed_texts(questions, "query"))
+        n = self.evidence_emb.shape[0]
+        k = min(topk, n)
+
+        @jax.jit
+        def chunk_topk(q, ev):
+            s = q @ ev.T
+            kk = min(k, s.shape[-1])
+            return jax.lax.top_k(s, kk)
+
+        best_scores = np.full((len(questions), 0), -np.inf, np.float32)
+        best_idx = np.zeros((len(questions), 0), np.int64)
+        for lo in range(0, n, chunk_rows):
+            ev = jnp.asarray(self.evidence_emb[lo:lo + chunk_rows])
+            s, i = chunk_topk(q, ev)
+            best_scores = np.concatenate(
+                [best_scores, np.asarray(s)], axis=1)
+            best_idx = np.concatenate(
+                [best_idx, np.asarray(i, np.int64) + lo], axis=1)
+            order = np.argsort(-best_scores, axis=1)[:, :k]
+            best_scores = np.take_along_axis(best_scores, order, axis=1)
+            best_idx = np.take_along_axis(best_idx, order, axis=1)
         return [
-            ([self.evidence_ids[j] for j in top_idx[i]],
-             list(top_scores[i]))
+            ([self.evidence_ids[j] for j in best_idx[i]],
+             list(best_scores[i]))
             for i in range(len(questions))
         ]
 

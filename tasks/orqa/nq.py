@@ -19,7 +19,7 @@ def read_nq_file(path: str) -> List[Tuple[str, List[str]]]:
     rows = []
     with open(path, newline="") as f:
         for row in csv.reader(f, delimiter="\t"):
-            if not row:
+            if len(row) < 2:  # blank or truncated line: skip
                 continue
             question = row[0]
             try:

@@ -1,0 +1,185 @@
+"""Supervised retriever finetuning on Natural Questions (RET-FINETUNE-NQ).
+
+Parity target: ref tasks/orqa/supervised/{data.py,finetune.py,eval_utils.py}
+— DPR-format json samples {question, answers, positive_ctxs[, hard
+negatives]}, each batch trains the biencoder with in-batch softmax
+retrieval (every other sample's positive context is a negative; one hard
+negative per query optionally appended, ref finetune.py:96-150), and
+validation reports in-batch top-k retrieval accuracy
+(ref eval_utils.py:124-180).
+
+TPU-first: the whole step (two tower forwards, the (b, b[*2]) score
+matmul, CE, Adam) is one jitted function; the reference's cross-GPU
+context gather (finetune.py:26-44) is GSPMD's job.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def read_dpr_json(path: str) -> List[dict]:
+    """DPR retriever-train format (ref: data.py process_samples_from_...).
+    Accepts a json array or jsonl."""
+    with open(path) as f:
+        first = f.read(1)
+        f.seek(0)
+        if first == "[":
+            return json.load(f)
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _encode(tokenizer, text: str, title: Optional[str], max_len: int):
+    ids = tokenizer.tokenize(text)
+    if title is not None:
+        ids = tokenizer.tokenize(title) + [tokenizer.sep] + ids
+    ids = [tokenizer.cls] + ids[: max_len - 2] + [tokenizer.sep]
+    out = np.full((max_len,), tokenizer.pad, np.int32)
+    out[: len(ids)] = ids
+    mask = np.zeros((max_len,), np.int32)
+    mask[: len(ids)] = 1
+    return out, mask
+
+
+class OpenRetrievalDataset:
+    """(query, positive ctx[, hard negative ctx]) token batches
+    (ref: data.py OpenRetrievalAbstractDataset)."""
+
+    def __init__(self, path: str, tokenizer, max_seq_length: int = 128,
+                 use_hard_negatives: bool = False, seed: int = 1234):
+        self.samples = read_dpr_json(path)
+        self.tokenizer = tokenizer
+        self.max_seq_length = max_seq_length
+        self.use_hard_negatives = use_hard_negatives
+        self.rng = np.random.RandomState(seed)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        s = self.samples[idx]
+        q_ids, q_mask = _encode(self.tokenizer, s["question"], None,
+                                self.max_seq_length)
+        pos = s["positive_ctxs"][0]
+        c_ids, c_mask = _encode(self.tokenizer, pos["text"],
+                                pos.get("title"), self.max_seq_length)
+        out = {"query": q_ids, "query_mask": q_mask,
+               "context": c_ids, "context_mask": c_mask}
+        if self.use_hard_negatives:
+            negs = s.get("hard_negative_ctxs") or s.get("negative_ctxs") \
+                or []
+            neg = negs[int(self.rng.randint(len(negs)))] if negs else pos
+            n_ids, n_mask = _encode(self.tokenizer, neg["text"],
+                                    neg.get("title"), self.max_seq_length)
+            out["neg_context"] = n_ids
+            out["neg_context_mask"] = n_mask
+        return out
+
+
+def _batch(ds, idxs):
+    rows = [ds[int(i)] for i in idxs]
+    return {k: jnp.asarray(np.stack([r[k] for r in rows]))
+            for k in rows[0]}
+
+
+def make_loss_fn(model, use_hard_negatives: bool):
+    """In-batch softmax retrieval CE; hard negatives append b more
+    context columns (ref: finetune.py:96-150)."""
+    from megatron_llm_tpu.parallel.cross_entropy import cross_entropy
+
+    def embed(tower, params, tokens, mask):
+        p = params["shared"] if "shared" in params else params[tower]
+        return model.embed_text(p, tokens, mask)
+
+    def loss_fn(params, batch, rng=None):
+        q = embed("query", params, batch["query"], batch["query_mask"])
+        c = embed("context", params, batch["context"],
+                  batch["context_mask"])
+        if use_hard_negatives and "neg_context" in batch:
+            n = embed("context", params, batch["neg_context"],
+                      batch["neg_context_mask"])
+            c = jnp.concatenate([c, n], axis=0)  # (2b, d)
+        scores = q.astype(jnp.float32) @ c.astype(jnp.float32).T
+        targets = jnp.arange(q.shape[0])
+        losses = cross_entropy(scores, targets)
+        top1 = jnp.mean(
+            (jnp.argmax(scores, axis=-1) == targets).astype(jnp.float32)
+        )
+        return jnp.mean(losses), top1
+
+    return loss_fn
+
+
+def in_batch_topk_accuracy(model, params, ds, batch_size: int,
+                           ks=(1, 5)) -> dict:
+    """Validation: retrieval rank of each query's own positive within the
+    batch (ref: eval_utils.py retrieval_loss + topk_accuracy)."""
+    loss_fn = make_loss_fn(model, use_hard_negatives=False)
+
+    @jax.jit
+    def score(params, batch):
+        q = model.embed_text(
+            params["shared"] if "shared" in params else params["query"],
+            batch["query"], batch["query_mask"])
+        c = model.embed_text(
+            params["shared"] if "shared" in params else params["context"],
+            batch["context"], batch["context_mask"])
+        return q.astype(jnp.float32) @ c.astype(jnp.float32).T
+
+    hits = {k: 0 for k in ks}
+    total = 0
+    for lo in range(0, len(ds) - batch_size + 1, batch_size):
+        batch = _batch(ds, range(lo, lo + batch_size))
+        s = np.asarray(score(params, batch))
+        order = np.argsort(-s, axis=-1)
+        for i in range(s.shape[0]):
+            rank = int(np.where(order[i] == i)[0][0])
+            for k in ks:
+                hits[k] += rank < k
+        total += s.shape[0]
+    return {k: hits[k] / max(total, 1) for k in ks}
+
+
+def finetune_retriever(model, params, train_ds, valid_ds=None,
+                       epochs: int = 2, batch_size: int = 8,
+                       lr: float = 2e-5, use_hard_negatives: bool = False,
+                       seed: int = 1234, log_interval: int = 10):
+    """Epoch loop (ref: finetune.py main via finetune_utils.finetune)."""
+    import optax
+
+    loss_fn = make_loss_fn(model, use_hard_negatives)
+    opt = optax.adamw(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        (loss, top1), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, top1
+
+    rng = np.random.RandomState(seed)
+    it = 0
+    for epoch in range(epochs):
+        order = rng.permutation(len(train_ds))
+        for lo in range(0, len(train_ds) - batch_size + 1, batch_size):
+            batch = _batch(train_ds, order[lo:lo + batch_size])
+            params, opt_state, loss, top1 = step(params, opt_state, batch)
+            it += 1
+            if it % log_interval == 0:
+                print(f"epoch {epoch} iter {it}: loss "
+                      f"{float(loss):.4f} in-batch top1 "
+                      f"{float(top1):.3f}", flush=True)
+        if valid_ds is not None:
+            acc = in_batch_topk_accuracy(model, params, valid_ds,
+                                         batch_size)
+            print(f"epoch {epoch} validation in-batch accuracy: "
+                  + ", ".join(f"top-{k} {v:.4f}"
+                              for k, v in acc.items()),
+                  flush=True)
+    return params

@@ -211,6 +211,55 @@ class TestORQAMatching:
 
 
 @pytest.mark.slow
+class TestRetrieverFinetune:
+    def test_overfits_tiny_dpr_set(self, tmp_path):
+        """RET-FINETUNE-NQ core: in-batch softmax retrieval training on a
+        DPR-format fixture must reach perfect in-batch top-1 on the
+        training pairs (8 distinct query/context pairs, batch=4)."""
+        import jax
+
+        from megatron_llm_tpu.config import bert_config
+        from megatron_llm_tpu.models.biencoder import BiEncoderModel
+        from megatron_llm_tpu.tokenizer import build_tokenizer
+        from tasks.orqa.supervised import (
+            OpenRetrievalDataset,
+            finetune_retriever,
+            in_batch_topk_accuracy,
+        )
+
+        words = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + \
+            [f"w{i}" for i in range(32)]
+        vocab = tmp_path / "vocab.txt"
+        vocab.write_text("\n".join(words) + "\n")
+        samples = [
+            {"question": f"w{i} w{i+1}",
+             "answers": [f"w{i+8}"],
+             "positive_ctxs": [{"title": f"w{i+16}",
+                                "text": f"w{i+8} w{i+24}"}]}
+            for i in range(8)
+        ]
+        data = tmp_path / "nq_train.json"
+        data.write_text(json.dumps(samples))
+
+        tokenizer = build_tokenizer("BertWordPieceLowerCase",
+                                    vocab_file=str(vocab))
+        cfg = bert_config(num_layers=2, hidden_size=64,
+                          num_attention_heads=4, ffn_hidden_size=128,
+                          seq_length=32, vocab_size=tokenizer.vocab_size,
+                          compute_dtype=np.float32,
+                          hidden_dropout=0.0, attention_dropout=0.0,
+                          add_binary_head=False)
+        model = BiEncoderModel(cfg)
+        params = model.init(jax.random.key(0))
+        ds = OpenRetrievalDataset(str(data), tokenizer, max_seq_length=16)
+        params = finetune_retriever(model, params, ds, None, epochs=100,
+                                    batch_size=4, lr=1e-3,
+                                    log_interval=1000)
+        acc = in_batch_topk_accuracy(model, params, ds, batch_size=4)
+        assert acc[1] == 1.0, acc
+
+
+@pytest.mark.slow
 class TestRetrieverEvalCLI:
     def test_retriever_eval_end_to_end(self, tmp_path):
         # evidence TSV + NQ TSV fixtures; vocab for BertWordPiece
