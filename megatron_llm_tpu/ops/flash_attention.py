@@ -63,6 +63,36 @@ def _xla_reference(q, k, v, causal: bool):
     return jnp.einsum("bgqst,btgd->bsgqd", probs, v)
 
 
+def _xla_reference_with_lse(q, k, v, causal: bool):
+    """Reference path that also returns the per-row logsumexp
+    (b, s, g, qpk) fp32 — differentiable through BOTH outputs (autodiff;
+    the merge-across-blocks users need d/dlse)."""
+    b, s, g, qpk, d = q.shape
+    t = k.shape[1]
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    scores = jnp.einsum("bsgqd,btgd->bgqst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = jnp.arange(s)[:, None]
+        cols = jnp.arange(t)[None, :]
+        scores = jnp.where(cols > rows, NEG_INF, scores)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)  # (b, g, qpk, s)
+    probs = jnp.exp(scores - lse[..., None]).astype(v.dtype)
+    o = jnp.einsum("bgqst,btgd->bsgqd", probs, v)
+    return o, jnp.moveaxis(lse, 3, 1)  # lse -> (b, s, g, qpk)
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct carrying the operand's varying-manual-axes set:
+    inside a shard_map manual region (ring attention's per-hop call) the
+    kernel outputs must declare how they vary across the manual axes or
+    tracing rejects them (check_vma)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _choose_block(size: int, requested: int, qpk: int = 1):
     """Largest power-of-2 block <= requested that divides `size` and keeps
     folded rows (block*qpk) under MAX_ROWS. None if nothing fits (caller
@@ -197,8 +227,8 @@ def _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret=False):
             pl.BlockSpec((1, block_q * qpk, 1), lambda h, i, j: (h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * g, s, qpk * d), q.dtype),
-            jax.ShapeDtypeStruct((b * g, s * qpk, 1), jnp.float32),
+            _out_struct((b * g, s, qpk * d), q.dtype, qf),
+            _out_struct((b * g, s * qpk, 1), jnp.float32, qf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q * qpk, 1), jnp.float32),
@@ -306,7 +336,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
-                      interpret=False):
+                      interpret=False, dlse_rows=None):
     b, s, g, qpk, d = q.shape
     t = k.shape[1]
     sm_scale = 1.0 / (d ** 0.5)
@@ -321,6 +351,11 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     ).transpose(0, 2, 1, 3).reshape(b * g, s * qpk, 1)
+    if dlse_rows is not None:
+        # lse as a primal OUTPUT: d lse / d score_ij = p_ij, so the score
+        # cotangent gains + g_lse * p — exactly ds = p*(dp - (delta -
+        # g_lse)); folding it into delta costs nothing in-kernel
+        delta = delta - dlse_rows
 
     num_q_blocks = s // block_q
     num_k_blocks = t // block_k
@@ -357,7 +392,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
         grid=(b * g, num_q_blocks, num_k_blocks),
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * g, s, qpk * d), q.dtype),
+        out_shape=_out_struct((b * g, s, qpk * d), q.dtype, qf),
         scratch_shapes=[pltpu.VMEM((block_q * qpk, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
@@ -382,8 +417,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * g, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b * g, t, d), v.dtype),
+            _out_struct((b * g, t, d), k.dtype, qf),
+            _out_struct((b * g, t, d), v.dtype, qf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -428,6 +463,86 @@ def _flash_bwd_rule(config, residuals, g):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _lse_rows_to_bsgq(lse_rows, b, s, g, qpk):
+    # (b*g, s*qpk, 1) rows-major (head fastest) -> (b, s, g, qpk)
+    return lse_rows.reshape(b, g, s, qpk).transpose(0, 2, 1, 3)
+
+
+def _lse_bsgq_to_rows(lse, b, s, g, qpk):
+    return lse.transpose(0, 2, 1, 3).reshape(b * g, s * qpk, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_lse(config, q, k, v):
+    causal, block_q, block_k, interpret = config
+    b, s, g, qpk, _ = q.shape
+    o, lse = _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return o, _lse_rows_to_bsgq(lse, b, s, g, qpk)
+
+
+def _flash_lse_fwd_rule(config, q, k, v):
+    causal, block_q, block_k, interpret = config
+    b, s, g, qpk, _ = q.shape
+    o, lse = _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return (o, _lse_rows_to_bsgq(lse, b, s, g, qpk)), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd_rule(config, residuals, cts):
+    causal, block_q, block_k, interpret = config
+    q, k, v, o, lse = residuals
+    do, dlse = cts
+    b, s, g, qpk, _ = q.shape
+    dlse_rows = _lse_bsgq_to_rows(dlse.astype(jnp.float32), b, s, g, qpk)
+    return _flash_bwd_pallas(
+        q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+        dlse_rows=dlse_rows,
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    use_pallas: bool | None = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+):
+    """Like `flash_attention` but ALSO returns the per-row logsumexp
+    (b, s, g, qpk) fp32, differentiable through both outputs — the
+    building block for merging attention across blocks that live on
+    different devices (ring attention's per-hop step)."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        blocks = _pick_blocks(q.shape[1], k.shape[1], q.shape[-1],
+                              q.shape[3], block_q, block_k)
+        if blocks is not None:
+            return _flash_lse((causal, *blocks, interpret), q, k, v)
+    return _xla_reference_with_lse(q, k, v, causal)
+
+
+def _pick_blocks(s, t, d, qpk, block_q, block_k):
+    """Shared block selection for both entry points: shrink to divisors,
+    bound the fp32 score block rows*block_k under VMEM (MAX_CELLS), gate
+    on lane alignment. Returns (bq, bk) or None for the XLA fallback."""
+    bq = _choose_block(s, block_q, qpk)
+    bk = _choose_block(t, block_k)
+    while (bq is not None and bk is not None and bk > 128
+           and bq * qpk * bk > MAX_CELLS):
+        bk = _choose_block(t, bk // 2)
+    while (bq is not None and bk is not None
+           and bq * qpk * bk > MAX_CELLS and bq * qpk > 256):
+        bq = _choose_block(s, bq // 2, qpk)
+    if bq is None or bk is None or d % 128 != 0:
+        return None
+    return bq, bk
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "use_pallas",
                                              "block_q", "block_k",
                                              "interpret"))
@@ -445,17 +560,8 @@ def flash_attention(
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
-        s, t, d = q.shape[1], k.shape[1], q.shape[-1]
-        qpk = q.shape[3]
-        bq = _choose_block(s, block_q, qpk)
-        bk = _choose_block(t, block_k)
-        # bound the fp32 score block rows*block_k (VMEM)
-        while (bq is not None and bk is not None and bk > 128
-               and bq * qpk * bk > MAX_CELLS):
-            bk = _choose_block(t, bk // 2)
-        while (bq is not None and bk is not None
-               and bq * qpk * bk > MAX_CELLS and bq * qpk > 256):
-            bq = _choose_block(s, bq // 2, qpk)
-        if bq is not None and bk is not None and d % 128 == 0:
-            return _flash((causal, bq, bk, interpret), q, k, v)
+        blocks = _pick_blocks(q.shape[1], k.shape[1], q.shape[-1],
+                              q.shape[3], block_q, block_k)
+        if blocks is not None:
+            return _flash((causal, *blocks, interpret), q, k, v)
     return _xla_reference(q, k, v, causal)

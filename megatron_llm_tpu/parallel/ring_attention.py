@@ -8,12 +8,13 @@ implementations use the same scan+ppermute shape):
 
 - the sequence axis is sharded over a mesh axis (`cp`): each device holds
   its (b, s/cp, ...) slice of Q, K, V;
-- cp steps of a `lax.scan`: each step computes this device's Q block
-  against the currently-resident K/V block with an online-softmax update
-  (running row-max m, denominator l, accumulator o — the flash-attention
-  recurrence across devices), then `ppermute` rotates K/V one hop around
-  the ring, so K/V traffic rides neighbour ICI links and overlaps with
-  the block matmuls;
+- cp steps of a `lax.scan`: each step runs the FLASH kernel
+  (ops/flash_attention.py — Pallas on TPU, so the per-hop score tile
+  lives in VMEM, never HBM) on the currently-resident K/V block and
+  merges hops by logsumexp (running row-max m, denominator l,
+  accumulator o — the flash recurrence lifted across devices), then
+  `ppermute` rotates K/V one hop around the ring, so K/V traffic rides
+  neighbour ICI links and overlaps with the block compute;
 - causal masking uses each block's ORIGIN index ((idx - t) mod cp) to
   reconstruct global positions, and blocks entirely above the diagonal
   skip both einsums via `lax.cond` (per-device branch in the manual
@@ -38,41 +39,45 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
-def ring_self_attention(q, k, v, axis_name: str, causal: bool = True):
+def ring_self_attention(q, k, v, axis_name: str, causal: bool = True,
+                        use_pallas: bool | None = None,
+                        interpret: bool = False):
     """Inside a shard_map region with the sequence sharded over
     `axis_name`: exact attention over the GLOBAL sequence.
 
     q: (b, s_loc, g, qpk, d); k, v: (b, s_loc, g, d) — local slices.
     Returns (b, s_loc, g, qpk, d).
+
+    Each hop runs the FLASH kernel on the resident K/V block (Pallas on
+    TPU, XLA fallback elsewhere) and merges hop results via their
+    logsumexp — so the (s_loc x s_loc) score matrix is only ever tiled in
+    VMEM, never materialized in HBM, and the per-hop compute is the same
+    tuned kernel the non-ring path uses. Under the causal ring, the
+    resident (t=0) hop is the diagonal block (causal inside), later hops
+    are either fully visible (owner < idx: causal=False) or fully masked
+    (owner > idx: skipped before any compute).
     """
+    from megatron_llm_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+    )
+
     cp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s, g, qpk, d = q.shape
-    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    q_pos = idx * s + jnp.arange(s)  # global rows
 
-    def update(k_blk, v_blk, m, l, o, owner):
-        """Online-softmax merge of one K/V block into (m, l, o)."""
-        k_pos = owner * s + jnp.arange(s)
-        scores = jnp.einsum(
-            "bsgqd,btgd->bgqst", q, k_blk,
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            masked = (k_pos[None, :] > q_pos[:, None])  # (s, t)
-            scores = jnp.where(masked[None, None, None], NEG_INF, scores)
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
-        # clamp so fully-masked rows (m_new == NEG_INF) stay finite
-        m_safe = jnp.maximum(m_new, NEG_INF / 2)
-        p = jnp.exp(scores - m_safe[..., None])
-        if causal:
-            p = jnp.where(masked[None, None, None], 0.0, p)
-        corr = jnp.exp(m - m_safe)
-        l = l * corr + jnp.sum(p, axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bgqst,btgd->bgqsd", p.astype(v_blk.dtype), v_blk,
-            preferred_element_type=jnp.float32,
+    def merge(carry, k_blk, v_blk, diag: bool):
+        """Flash the hop, fold its (o, lse) into the running (m, l, o)."""
+        m, l, o = carry
+        o_h, lse_h = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=diag, use_pallas=use_pallas,
+            interpret=interpret,
         )
+        m_new = jnp.maximum(m, lse_h)
+        m_safe = jnp.maximum(m_new, NEG_INF / 2)
+        corr = jnp.exp(m - m_safe)
+        w = jnp.exp(lse_h - m_safe)  # hop weight: sum exp(s - m_safe)
+        l = l * corr + w
+        o = o * corr[..., None] + o_h.astype(jnp.float32) * w[..., None]
         return m_new, l, o
 
     def step(carry, t):
@@ -85,42 +90,45 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True):
         # after t rotations this block originated on (idx - t) mod cp
         owner = (idx - t) % cp
         if causal:
-            # blocks entirely above the diagonal (owner strictly after this
-            # device in global order) contribute nothing: skip both einsums
+            # blocks entirely above the diagonal (owner strictly after
+            # this device in global order) contribute nothing: skip the
+            # kernel entirely; visible blocks attend in full
             m, l, o = jax.lax.cond(
                 owner > idx,
-                lambda args: args[2:5],
-                lambda args: update(*args),
-                (k_blk, v_blk, m, l, o, owner),
+                lambda kb, vb, c: c,
+                lambda kb, vb, c: merge(c, kb, vb, diag=False),
+                k_blk, v_blk, (m, l, o),
             )
         else:
-            m, l, o = update(k_blk, v_blk, m, l, o, owner)
+            m, l, o = merge((m, l, o), k_blk, v_blk, diag=False)
         return (k_blk, v_blk, m, l, o), None
 
     step = jax.checkpoint(step, prevent_cse=False)
     # mark the zero initials device-varying so scan carry types are stable
     pv = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")  # noqa: E731
-    m0 = pv(jnp.full((b, g, qpk, s), NEG_INF, jnp.float32))
-    l0 = pv(jnp.zeros((b, g, qpk, s), jnp.float32))
-    o0 = pv(jnp.zeros((b, g, qpk, s, d), jnp.float32))
-    # the resident block (t = 0, owner = idx) merges without any rotation;
-    # the scan then covers the remaining cp - 1 ring hops
-    m1, l1, o1 = update(k, v, m0, l0, o0, idx)
+    m0 = pv(jnp.full((b, s, g, qpk), NEG_INF, jnp.float32))
+    l0 = pv(jnp.zeros((b, s, g, qpk), jnp.float32))
+    o0 = pv(jnp.zeros((b, s, g, qpk, d), jnp.float32))
+    # the resident block (t = 0, owner = idx) is the causal diagonal and
+    # merges without any rotation; the scan covers the cp - 1 ring hops
+    m1, l1, o1 = merge((m0, l0, o0), k, v, diag=causal)
     (k_f, v_f, m, l, o), _ = jax.lax.scan(
         step, (k, v, m1, l1, o1), jnp.arange(1, cp)
     )
     out = o / jnp.maximum(l, 1e-30)[..., None]
-    # (b, g, qpk, s, d) -> (b, s, g, qpk, d)
-    return jnp.moveaxis(out, 3, 1).astype(q.dtype)
+    return out.astype(q.dtype)  # already (b, s, g, qpk, d)
 
 
 def make_ring_attention(mesh, cp_axis: str, causal: bool = True,
-                        batch_axis=None):
+                        batch_axis=None, use_pallas: bool | None = None,
+                        interpret: bool = False):
     """Jittable global-array entry: shards the sequence over `cp_axis`
     (and optionally batch over `batch_axis`) and runs the ring.
 
     q (b, S, g, qpk, d), k/v (b, S, g, d) with S divisible by the cp
     degree. Differentiable; use inside a larger jitted step or alone.
+    `use_pallas`/`interpret` reach the per-hop flash kernel (CI runs the
+    REAL kernel inside the ring via the Pallas interpreter).
     """
     qspec = P(batch_axis, cp_axis, None, None, None)
     kspec = P(batch_axis, cp_axis, None, None)
@@ -133,6 +141,8 @@ def make_ring_attention(mesh, cp_axis: str, causal: bool = True,
         axis_names={cp_axis} | ({batch_axis} if batch_axis else set()),
     )
     def ring(q, k, v):
-        return ring_self_attention(q, k, v, cp_axis, causal=causal)
+        return ring_self_attention(q, k, v, cp_axis, causal=causal,
+                                   use_pallas=use_pallas,
+                                   interpret=interpret)
 
     return ring

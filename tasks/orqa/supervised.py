@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+NEG_INF = -1e30
+
 
 def read_dpr_json(path: str) -> List[dict]:
     """DPR retriever-train format (ref: data.py process_samples_from_...).
@@ -73,9 +75,21 @@ class OpenRetrievalDataset:
         if self.use_hard_negatives:
             negs = s.get("hard_negative_ctxs") or s.get("negative_ctxs") \
                 or []
-            neg = negs[int(self.rng.randint(len(negs)))] if negs else pos
-            n_ids, n_mask = _encode(self.tokenizer, neg["text"],
-                                    neg.get("title"), self.max_seq_length)
+            if negs:
+                neg = negs[int(self.rng.randint(len(negs)))]
+                n_ids, n_mask = _encode(self.tokenizer, neg["text"],
+                                        neg.get("title"),
+                                        self.max_seq_length)
+                out["neg_valid"] = np.int32(1)
+            else:
+                # no negatives for this sample: emit a PAD row the loss
+                # masks out entirely — duplicating the positive would
+                # split its softmax mass and cancel the gradient
+                n_ids = np.full((self.max_seq_length,), self.tokenizer.pad,
+                                np.int32)
+                n_mask = np.zeros((self.max_seq_length,), np.int32)
+                n_mask[0] = 1  # keep one live token for the encoder
+                out["neg_valid"] = np.int32(0)
             out["neg_context"] = n_ids
             out["neg_context_mask"] = n_mask
         return out
@@ -87,24 +101,35 @@ def _batch(ds, idxs):
             for k in rows[0]}
 
 
+def _embed(model, tower, params, tokens, mask):
+    """Shared/per-tower dispatch used by loss AND eval."""
+    p = params["shared"] if "shared" in params else params[tower]
+    return model.embed_text(p, tokens, mask)
+
+
 def make_loss_fn(model, use_hard_negatives: bool):
     """In-batch softmax retrieval CE; hard negatives append b more
-    context columns (ref: finetune.py:96-150)."""
+    context columns, pad rows masked out via neg_valid
+    (ref: finetune.py:96-150)."""
     from megatron_llm_tpu.parallel.cross_entropy import cross_entropy
 
-    def embed(tower, params, tokens, mask):
-        p = params["shared"] if "shared" in params else params[tower]
-        return model.embed_text(p, tokens, mask)
-
     def loss_fn(params, batch, rng=None):
-        q = embed("query", params, batch["query"], batch["query_mask"])
-        c = embed("context", params, batch["context"],
-                  batch["context_mask"])
+        q = _embed(model, "query", params, batch["query"],
+                   batch["query_mask"])
+        c = _embed(model, "context", params, batch["context"],
+                   batch["context_mask"])
+        col_mask = None
         if use_hard_negatives and "neg_context" in batch:
-            n = embed("context", params, batch["neg_context"],
-                      batch["neg_context_mask"])
+            n = _embed(model, "context", params, batch["neg_context"],
+                       batch["neg_context_mask"])
             c = jnp.concatenate([c, n], axis=0)  # (2b, d)
+            col_mask = jnp.concatenate(
+                [jnp.ones((q.shape[0],), jnp.float32),
+                 batch["neg_valid"].astype(jnp.float32)]
+            )
         scores = q.astype(jnp.float32) @ c.astype(jnp.float32).T
+        if col_mask is not None:
+            scores = jnp.where(col_mask[None, :] > 0, scores, NEG_INF)
         targets = jnp.arange(q.shape[0])
         losses = cross_entropy(scores, targets)
         top1 = jnp.mean(
@@ -119,16 +144,13 @@ def in_batch_topk_accuracy(model, params, ds, batch_size: int,
                            ks=(1, 5)) -> dict:
     """Validation: retrieval rank of each query's own positive within the
     batch (ref: eval_utils.py retrieval_loss + topk_accuracy)."""
-    loss_fn = make_loss_fn(model, use_hard_negatives=False)
 
     @jax.jit
     def score(params, batch):
-        q = model.embed_text(
-            params["shared"] if "shared" in params else params["query"],
-            batch["query"], batch["query_mask"])
-        c = model.embed_text(
-            params["shared"] if "shared" in params else params["context"],
-            batch["context"], batch["context_mask"])
+        q = _embed(model, "query", params, batch["query"],
+                   batch["query_mask"])
+        c = _embed(model, "context", params, batch["context"],
+                   batch["context_mask"])
         return q.astype(jnp.float32) @ c.astype(jnp.float32).T
 
     hits = {k: 0 for k in ks}

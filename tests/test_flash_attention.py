@@ -157,3 +157,52 @@ class TestModelIntegration:
             np.asarray(flash_logits), np.asarray(ref_logits),
             rtol=1e-5, atol=1e-5,
         )
+
+
+class TestFlashWithLse:
+    """The (o, lse) variant that ring attention merges across hops —
+    both outputs and the d/dlse path must match the XLA reference
+    (the score cotangent gains + g_lse * p, folded into delta)."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_values_and_lse(self, causal):
+        from megatron_llm_tpu.ops.flash_attention import (
+            _xla_reference_with_lse,
+            flash_attention_with_lse,
+        )
+
+        q, k, v = _rand_qkv(2, 128, 2, 2, 128)
+        o1, l1 = flash_attention_with_lse(
+            q, k, v, causal=causal, use_pallas=True, interpret=True,
+            block_q=64, block_k=64,
+        )
+        o2, l2 = _xla_reference_with_lse(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_through_both_outputs(self):
+        from megatron_llm_tpu.ops.flash_attention import (
+            _xla_reference_with_lse,
+            flash_attention_with_lse,
+        )
+
+        q, k, v = _rand_qkv(1, 128, 2, 1, 128, seed=3)
+
+        def obj(impl):
+            def f(q, k, v):
+                o, lse = impl(q, k, v)
+                # nontrivial cotangents on BOTH outputs
+                return (o.astype(jnp.float32) ** 2).sum() \
+                    + jnp.sin(lse).sum()
+            return f
+
+        g1 = jax.grad(obj(lambda q, k, v: flash_attention_with_lse(
+            q, k, v, causal=True, use_pallas=True, interpret=True,
+            block_q=64, block_k=64)), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(obj(lambda q, k, v: _xla_reference_with_lse(
+            q, k, v, True)), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)

@@ -92,3 +92,33 @@ def test_ring_bf16_long_sequence():
     got = np.asarray(jax.jit(ring)(q, k, v), np.float32)
     want = np.asarray(_ref(q, k, v, True), np.float32)
     np.testing.assert_allclose(got, want, atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("cp,causal", [(2, True), (4, False)])
+def test_ring_with_real_kernel_interpreted(cp, causal):
+    """The flash-kernel-inside-ring composition itself: per-hop Pallas
+    kernels run through the interpreter (d=128 satisfies the lane gate),
+    values AND grads vs the dense reference."""
+    b, S, g, qpk, d = 1, 128, 2, 1, 128
+    kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(kq, (b, S, g, qpk, d), jnp.float32)
+    k = jax.random.normal(kk, (b, S, g, d), jnp.float32)
+    v = jax.random.normal(kv, (b, S, g, d), jnp.float32)
+
+    ring = make_ring_attention(_mesh(cp), "cp", causal=causal,
+                               use_pallas=True, interpret=True)
+    got = np.asarray(jax.jit(ring)(q, k, v))
+    want = np.asarray(_ref(q, k, v, causal))
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=1e-4)
+
+    def loss(impl):
+        return lambda q, k, v: (
+            impl(q, k, v).astype(jnp.float32) ** 2
+        ).sum()
+
+    g1 = jax.jit(jax.grad(loss(ring), argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: _ref(q, k, v, causal)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, bb in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=1e-4, rtol=2e-4)
