@@ -89,11 +89,17 @@ def run_train(seq, iters):
         params, opt_state, stats = step(params, opt_state, batch, lr, wd)
     float(stats["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, stats = step(params, opt_state, batch, lr, wd)
-    float(stats["loss"])
-    dt = time.perf_counter() - t0
+    # best of two passes: a transient host-load spike (anything else
+    # running on the VM) can halve a single measurement
+    best_dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, stats = step(params, opt_state, batch, lr,
+                                            wd)
+        float(stats["loss"])
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    dt = best_dt
 
     tok_per_sec = mbs * seq * iters / dt
     # fwd+bwd model FLOPs per token: 6*N for the matmuls + causal attention
