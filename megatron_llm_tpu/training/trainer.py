@@ -312,7 +312,26 @@ class Trainer:
         if self.valid_data_iterator is None:
             return float("nan")
         if self._eval_step_fn is None:
-            if self.batch_builder is not None:
+            if self.pcfg.pipeline_parallel_size > 1 \
+                    and self.batch_builder is None:
+                # stage-sharded params: eval through the pipelined loss
+                # (the non-pipelined path would all-gather every layer).
+                # num_micro is derived from the batch shape, so any
+                # (num_micro, rows, seq) eval batch works.
+                from megatron_llm_tpu.parallel.pipeline import (
+                    make_pipelined_loss_fn,
+                )
+
+                loss_fn = make_pipelined_loss_fn(
+                    self.model, self.pcfg, self.ctx
+                )
+
+                @jax.jit
+                def pp_eval(params, batch):
+                    return loss_fn(params, batch)
+
+                self._eval_step_fn = pp_eval
+            elif self.batch_builder is not None:
                 model = self.model
 
                 @jax.jit
@@ -343,6 +362,10 @@ class Trainer:
                 break
             if self.batch_builder is not None:
                 batch = self.batch_builder(text)
+            elif self.pcfg.pipeline_parallel_size > 1:
+                # pipelined eval keeps the (num_micro, rows, seq) axes
+                batch = get_batch(text, self.eod_token)
+                batch.pop("attention_mask", None)
             else:
                 raw = get_batch(text, self.eod_token)
                 batch = jax.tree.map(
@@ -353,11 +376,12 @@ class Trainer:
                     globalize_batch,
                 )
 
-                # batch_builder batches keep the micro axis (rows at 1);
-                # the GPT eval path flattened it (rows at 0)
+                # batch_builder AND pipelined eval batches keep the micro
+                # axis (rows at 1); the flat GPT eval path has rows at 0
+                flat_rows = (self.batch_builder is None
+                             and self.pcfg.pipeline_parallel_size == 1)
                 batch = globalize_batch(
-                    batch, self.ctx,
-                    row_axis=1 if self.batch_builder is not None else 0,
+                    batch, self.ctx, row_axis=0 if flat_rows else 1,
                 )
             total += float(eval_step(state.params, batch))
             count += 1

@@ -199,3 +199,47 @@ class TestPPCheckpointServing:
         out = generate_tokens(model, params, tokens,
                               jnp.asarray([4], jnp.int32), prefill_len=4)
         assert np.asarray(out.tokens).shape == (1, 24)
+
+
+class TestPipelinedEval:
+    def test_trainer_evaluate_on_pp_mesh_matches_single_device(self):
+        """Trainer.evaluate at pp>1 must route through the pipelined loss
+        (stage-sharded params stay put) and reproduce the single-device
+        validation loss."""
+        from megatron_llm_tpu.training.trainer import Trainer
+
+        cfg = _cfg()
+        rows = 4
+        batches = [
+            np.random.RandomState(7 + i).randint(
+                0, cfg.padded_vocab_size, (1, rows, cfg.seq_length + 1)
+            ).astype(np.int32)
+            for i in range(2)
+        ]
+        tcfg = TrainConfig(micro_batch_size=rows, global_batch_size=rows,
+                           lr=1e-4, train_iters=1, eval_iters=2)
+
+        destroy_parallel()
+        base = Trainer(LlamaModel(cfg), tcfg, ParallelConfig(),
+                       valid_data_iterator=list(batches))
+        base_state = base.setup()
+        ref = base.evaluate(base_state)
+
+        ctx = initialize_parallel(dp=1, pp=2, tp=2)
+        try:
+            pcfg = ParallelConfig(pipeline_parallel_size=2,
+                                  tensor_parallel_size=2,
+                                  num_microbatches=1)
+            tr = Trainer(LlamaModel(cfg), tcfg, pcfg,
+                         valid_data_iterator=list(batches))
+            state = tr.setup()
+            # same weights as the single-device run, stage-sharded
+            host = jax.tree.map(np.asarray, base_state.params)
+            specs = pipeline_param_specs(cfg, host)
+            sh = jax.tree.map(lambda s: NamedSharding(ctx.mesh, s), specs,
+                              is_leaf=lambda x: isinstance(x, P))
+            state.params = jax.device_put(host, sh)
+            got = tr.evaluate(state)
+        finally:
+            destroy_parallel()
+        np.testing.assert_allclose(ref, got, rtol=2e-4)
