@@ -189,9 +189,13 @@ def attention_block(
     """Full attention sublayer: fused qkv proj -> RoPE -> (cached) attention
     -> output proj (ref: ParallelAttention.forward transformer.py:412-537).
 
-    `kv_cache` = {"k": (b, maxT, g, d), "v": ..., "offset": scalar} for
-    incremental decode (ref: InferenceParams forward_step.py:17,
-    transformer.py:483-496).
+    `kv_cache` for incremental decode (ref: InferenceParams
+    forward_step.py:17, transformer.py:483-496), two forms:
+    - stacked (the decode hot path, what transformer_stack passes):
+      {"k": (L, b, maxT, g, d), "v": ..., "offset": scalar, "layer": idx}
+      — this layer's token column is updated IN PLACE inside the stack;
+    - per-layer {"k": (b, maxT, g, d), "v": ..., "offset": scalar} for
+      standalone single-layer use.
     """
     b, s, h = hidden.shape
     compute_dtype = cfg.compute_dtype
@@ -209,8 +213,30 @@ def attention_block(
         if rope_table is not None:
             q = apply_rope(q, rope_table, position_ids)
             k = apply_rope(k, rope_table, position_ids)
-        k_full = jax.lax.dynamic_update_slice_in_dim(kv_cache["k"], k, offset, axis=1)
-        v_full = jax.lax.dynamic_update_slice_in_dim(kv_cache["v"], v, offset, axis=1)
+        if "layer" in kv_cache:
+            # stacked-cache form (decode hot path): update THIS layer's
+            # token column in place inside the full (L, b, T, g, d) stack
+            # and slice the layer back for attention. Updating only the
+            # written column (instead of materializing a new per-layer
+            # buffer and re-stacking it through scan ys) measured 2.2x
+            # faster per decode step at b=8/T=576 on v5e.
+            lidx = kv_cache["layer"]
+            kc = jax.lax.dynamic_update_slice(
+                kv_cache["k"], k[None], (lidx, 0, offset, 0, 0)
+            )
+            vc = jax.lax.dynamic_update_slice(
+                kv_cache["v"], v[None], (lidx, 0, offset, 0, 0)
+            )
+            k_full = jax.lax.dynamic_index_in_dim(kc, lidx, 0, False)
+            v_full = jax.lax.dynamic_index_in_dim(vc, lidx, 0, False)
+            new_cache = {"k": kc, "v": vc, "offset": offset + s,
+                         "layer": lidx}
+        else:
+            k_full = jax.lax.dynamic_update_slice_in_dim(
+                kv_cache["k"], k, offset, axis=1)
+            v_full = jax.lax.dynamic_update_slice_in_dim(
+                kv_cache["v"], v, offset, axis=1)
+            new_cache = {"k": k_full, "v": v_full, "offset": offset + s}
         t = k_full.shape[1]
         # rows attend to cols <= offset+row
         rows = offset + jnp.arange(s)[:, None]
@@ -218,7 +244,6 @@ def attention_block(
         dec_mask = cols > rows  # (s, t)
         ctx = grouped_attention(q, k_full, v_full, dec_mask, cfg,
                                 dropout_rng, deterministic=True)
-        new_cache = {"k": k_full, "v": v_full, "offset": offset + s}
     else:
         if rope_table is not None:
             q = apply_rope(q, rope_table, position_ids)

@@ -258,11 +258,28 @@ def transformer_stack(
 
     idxs = layer_offset + jnp.arange(L)
     if kv_caches is not None:
-        xs = (layer_params, idxs, {"k": kv_caches["k"], "v": kv_caches["v"],
-                                   "offset": jnp.broadcast_to(kv_caches["offset"], (L,))})
-        f = body_ck if n_remat == L else body
-        (hidden,), caches_out = jax.lax.scan(f, (hidden,), xs)
-        new_caches = {"k": caches_out["k"], "v": caches_out["v"],
+        # Decode: the FULL (L, b, T, g, d) cache stacks ride the scan
+        # CARRY and each layer updates its token column in place
+        # (attention_block's stacked-cache form). The previous xs/ys form
+        # re-materialized and re-stacked every layer's whole cache per
+        # step — 2.2x slower per decode step (see attention.py).
+        offset = kv_caches["offset"]
+
+        def cache_body(carry, xs):
+            hidden, kc, vc = carry
+            params_l, idx = xs
+            cache_l = {"k": kc, "v": vc, "offset": offset,
+                       "layer": idx - layer_offset}
+            (out,), new_cache_l = body((hidden,), (params_l, idx, cache_l))
+            return (out, new_cache_l["k"], new_cache_l["v"]), None
+
+        f = jax.checkpoint(cache_body, prevent_cse=False) \
+            if n_remat == L else cache_body
+        (hidden, kc, vc), _ = jax.lax.scan(
+            f, (hidden, kv_caches["k"], kv_caches["v"]),
+            (layer_params, idxs),
+        )
+        new_caches = {"k": kc, "v": vc,
                       "offset": kv_caches["offset"] + hidden.shape[1]}
     else:
         xs = (layer_params, idxs, None)

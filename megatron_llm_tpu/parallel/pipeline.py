@@ -44,9 +44,6 @@ width.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -58,8 +55,6 @@ from megatron_llm_tpu.models.language_model import embed_tokens, lm_logits
 from megatron_llm_tpu.parallel.cross_entropy import cross_entropy
 from megatron_llm_tpu.parallel.mesh import (
     CONTEXT_AXIS,
-    DATA_AXIS,
-    MODEL_AXIS,
     STAGE_AXIS,
     ParallelContext,
 )

@@ -332,6 +332,11 @@ class Trainer:
 
                 self._eval_step_fn = pp_eval
             elif self.batch_builder is not None:
+                if self.pcfg.pipeline_parallel_size > 1:
+                    print("WARNING: eval with a batch_builder on a pp>1 "
+                          "mesh gathers the stage-sharded layers per "
+                          "microbatch (encoder models have no pipelined "
+                          "loss path)", flush=True)
                 model = self.model
 
                 @jax.jit
@@ -365,7 +370,11 @@ class Trainer:
             elif self.pcfg.pipeline_parallel_size > 1:
                 # pipelined eval keeps the (num_micro, rows, seq) axes
                 batch = get_batch(text, self.eod_token)
-                batch.pop("attention_mask", None)
+                # the pipelined loss builds its own causal masking and
+                # cannot honor per-document reset masks
+                assert "attention_mask" not in batch, (
+                    "pp>1 eval does not support reset_attention_mask"
+                )
             else:
                 raw = get_batch(text, self.eod_token)
                 batch = jax.tree.map(
