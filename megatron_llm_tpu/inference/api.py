@@ -25,16 +25,17 @@ from megatron_llm_tpu.inference.tokenization import (
 )
 
 
-# (model id, mesh) -> jitted pipelined scorer. The params cache instead
-# holds a STRONG reference to the source tree and compares identity —
-# keying on id() alone could alias a recycled address after a checkpoint
-# reload and silently serve the old weights.
+# (model, mesh) -> jitted pipelined scorer. Keyed on the model OBJECT
+# (strong ref, object-identity hash) — keying on id() alone could alias a
+# recycled address after the model is garbage-collected and silently serve
+# a scorer traced for the old config (ADVICE r4). The params cache
+# likewise holds strong refs and compares identity.
 _PP_SCORE_CACHE: dict = {}
 _PP_PARAMS_CACHE: dict = {}  # {"model": .., "mesh": .., "src": .., "out": ..}
 
 
 def _pp_score_fn(model, ctx):
-    key = (id(model), ctx.mesh)
+    key = (model, ctx.mesh)
     if key not in _PP_SCORE_CACHE:
         from megatron_llm_tpu.config import ParallelConfig
         from megatron_llm_tpu.parallel.pipeline import (

@@ -231,17 +231,16 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 )
 
             def head_losses(hidden, lbl_t, lm_t):
+                # returns LOCAL (this context shard's) sums; the context
+                # psum happens outside the banking lax.cond — a collective
+                # inside that cond aborts XLA-CPU (same restructure as the
+                # score path's unconditional ppermute; ADVICE r4)
                 h = apply_norm(
                     hidden.astype(cfg.compute_dtype), aux["final_norm"], cfg
                 )
                 logits = lm_logits(aux, cfg, h)
                 losses = cross_entropy(logits, lbl_t)
-                s_l, d_l = jnp.sum(losses * lm_t), jnp.sum(lm_t)
-                if cp > 1:
-                    # each context shard holds s/cp tokens of the microbatch
-                    s_l = jax.lax.psum(s_l, CONTEXT_AXIS)
-                    d_l = jax.lax.psum(d_l, CONTEXT_AXIS)
-                return s_l, d_l
+                return jnp.sum(losses * lm_t), jnp.sum(lm_t)
 
             def tick(carry, t):
                 state, sums, denoms = carry
@@ -277,7 +276,7 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 lbl_t = jax.lax.dynamic_index_in_dim(lbls, m_out, 0, False)
                 lm_t = jax.lax.dynamic_index_in_dim(lmask, m_out, 0, False)
                 zero = jax.lax.pcast(
-                    jnp.float32(0.0), (STAGE_AXIS,), to="varying"
+                    jnp.float32(0.0), manual_axes, to="varying"
                 )
                 sum_t, den_t = jax.lax.cond(
                     valid,
@@ -285,6 +284,14 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                     lambda h: (zero, zero),
                     out,
                 )
+                if cp > 1:
+                    # each context shard holds s/cp tokens of the micro-
+                    # batch; `valid` is uniform across context shards at a
+                    # given stage, so psum of the selected values equals
+                    # the old psum-inside-head_losses — without a
+                    # collective inside the cond
+                    sum_t = jax.lax.psum(sum_t, CONTEXT_AXIS)
+                    den_t = jax.lax.psum(den_t, CONTEXT_AXIS)
                 sums = jax.lax.dynamic_update_index_in_dim(
                     sums,
                     jnp.where(
