@@ -55,6 +55,9 @@ def main(argv=None):
             tokenizer_model=args.tokenizer_model,
             make_vocab_size_divisible_by=args.make_vocab_size_divisible_by,
             tensor_parallel_size=args.tensor_model_parallel_size,
+            vocab_extra_ids=args.vocab_extra_ids,
+            vocab_extra_ids_list=args.vocab_extra_ids_list,
+            new_tokens=args.new_tokens,
             null_vocab_size=args.null_vocab_size,
         )
         vocab_size = tokenizer.vocab_size
@@ -88,13 +91,19 @@ def main(argv=None):
         """ref: train_valid_test_datasets_provider (finetune.py:104-126)."""
         from megatron_llm_tpu.data import build_train_valid_test_datasets
 
-        assert dargs.data_path, "--data_path is required"
+        assert dargs.data_path or dargs.train_data_path, (
+            "--data_path (or --train_data_path/--valid_data_path/"
+            "--test_data_path) is required"
+        )
         return build_train_valid_test_datasets(
             data_prefix=dargs.data_path,
             splits_string=dargs.split,
             train_valid_test_num_samples=train_val_test_num_samples,
             seq_length=mcfg.seq_length,
             seed=tcfg.seed,
+            train_data_prefix=dargs.train_data_path,
+            valid_data_prefix=dargs.valid_data_path,
+            test_data_prefix=dargs.test_data_path,
         )
 
     pretrain(

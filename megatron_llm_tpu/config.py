@@ -260,6 +260,7 @@ class TrainConfig:
     load: Optional[str] = None
     save_interval: Optional[int] = None
     finetune: bool = False
+    no_save_optim: bool = False
     no_load_optim: bool = False
     no_load_rng: bool = False
 
@@ -268,7 +269,23 @@ class TrainConfig:
     eval_interval: int = 1000
     eval_iters: int = 100
     tensorboard_dir: Optional[str] = None
+    # ref: --tensorboard_log_interval/--tensorboard_queue_size and the
+    # log_*_to_tensorboard toggles (arguments.py:477-529)
+    tensorboard_log_interval: int = 1
+    tensorboard_queue_size: int = 1000
+    log_timers_to_tensorboard: bool = False
+    log_validation_ppl_to_tensorboard: bool = False
+    log_memory_to_tensorboard: bool = False
+    log_world_size_to_tensorboard: bool = False
+    # ref: --timing_log_level/--timing_log_option (arguments.py:493-508)
+    timing_log_level: int = 0
+    timing_log_option: str = "minmax"
     wandb_logger: bool = False
+    wandb_project: Optional[str] = None
+    wandb_entity: Optional[str] = None
+    wandb_id: Optional[str] = None
+    wandb_resume: bool = False
+    wandb_api_key: Optional[str] = None
     # ref: --log-params-norm / --log-num-zeros-in-grad (arguments.py:481-487)
     log_params_norm: bool = False
     log_num_zeros_in_grad: bool = False
@@ -285,6 +302,21 @@ class TrainConfig:
         assert not (self.fp16 and self.bf16)
         if self.train_iters is not None and self.train_samples is not None:
             raise ValueError("specify train_iters or train_samples, not both")
+        # iteration- and sample-based schedules must not mix (ref:
+        # validate_args arguments.py:98-130)
+        if self.train_samples is not None:
+            if self.lr_decay_iters is not None or self.lr_warmup_iters:
+                raise ValueError(
+                    "sample-based run (--train_samples): use "
+                    "--lr_decay_samples/--lr_warmup_samples, not the "
+                    "*_iters variants"
+                )
+        elif self.lr_decay_samples is not None or self.lr_warmup_samples:
+            raise ValueError(
+                "--lr_decay_samples/--lr_warmup_samples require "
+                "--train_samples (iteration-based runs use the *_iters "
+                "variants)"
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -317,10 +317,21 @@ def build_train_valid_test_datasets(
         blend = lambda ds: BlendableDataset(ds, weights) if ds else None
         return blend(train_sets), blend(valid_sets), blend(test_sets)
 
-    # separate prefixes per split (ref :78-128)
+    # separate prefixes per split (ref :78-128); each split may itself be
+    # a weighted blend (ref _build_dataset :100-128)
     def single(prefix, name, n):
         if prefix is None:
             return None
+        if isinstance(prefix, (list, tuple)):
+            if len(prefix) == 1:
+                prefix = prefix[0]
+            else:
+                prefixes, weights, per_ds_n = \
+                    get_datasets_weights_and_num_samples(prefix, [n])
+                parts = [single(p, name, nn[0])
+                         for p, nn in zip(prefixes, per_ds_n)]
+                parts = [p for p in parts if p]
+                return BlendableDataset(parts, weights) if parts else None
         ds = make_dataset(prefix, data_impl)
         documents = np.arange(ds.sizes.shape[0], dtype=np.int32)
         return GPTDataset(name, prefix, documents, ds, n, seq_length, seed,

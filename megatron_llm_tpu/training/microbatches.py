@@ -89,6 +89,33 @@ class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
         )
 
 
+def iterations_for_samples(
+    target_samples: int,
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+    rampup_batch_size: Optional[Sequence[int]] = None,
+) -> int:
+    """Exact iteration count to consume `target_samples` under the (possibly
+    ramping) batch schedule — what the reference computes by stepping
+    update_num_microbatches over train_samples (training.py:126-141).
+    Walks the ramp phase step by step, then closes arithmetically."""
+    calc = build_num_microbatches_calculator(
+        global_batch_size, micro_batch_size, data_parallel_size,
+        rampup_batch_size,
+    )
+    consumed, iters = 0, 0
+    while consumed < target_samples:
+        bs = calc.get_current_global_batch_size()
+        if bs >= global_batch_size:  # ramp done (or constant): close out
+            remaining = target_samples - consumed
+            return iters + -(-remaining // bs)
+        consumed += bs
+        iters += 1
+        calc.update(consumed, consistency_check=False)
+    return iters
+
+
 def build_num_microbatches_calculator(
     global_batch_size: int,
     micro_batch_size: int,

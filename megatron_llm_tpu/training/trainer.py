@@ -119,7 +119,7 @@ class Trainer:
         self.reset_position_ids = reset_position_ids
         self.reset_attention_mask = reset_attention_mask
         self.eod_mask_loss = eod_mask_loss
-        self.timers = Timers()
+        self.timers = Timers(tcfg.timing_log_level, tcfg.timing_log_option)
         self._n_params = 0  # set in setup(); enables the TFLOP/s log field
         self._trace_active = False
         self.ctx = get_context()
@@ -132,8 +132,20 @@ class Trainer:
             tcfg.rampup_batch_size,
         )
 
-        decay_steps = tcfg.lr_decay_iters or tcfg.train_iters
-        warmup = tcfg.lr_warmup_iters
+        # sample-based runs (ref: --train_samples, training.py:120-141):
+        # the scheduler's step unit becomes SAMPLES — each iteration
+        # advances it by that iteration's global batch size, so batch-size
+        # rampup stretches warmup/decay in real data consumed, exactly as
+        # the reference's increment=get_current_global_batch_size().
+        self._samples_mode = tcfg.train_samples is not None
+        if self._samples_mode:
+            decay_steps = tcfg.lr_decay_samples or tcfg.train_samples
+            warmup = tcfg.lr_warmup_samples
+            wd_incr_steps = tcfg.train_samples
+        else:
+            decay_steps = tcfg.lr_decay_iters or tcfg.train_iters
+            warmup = tcfg.lr_warmup_iters
+            wd_incr_steps = tcfg.train_iters
         if tcfg.lr_warmup_fraction is not None and decay_steps:
             # ref: validate_args derives warmup from the effective decay span
             warmup = int(tcfg.lr_warmup_fraction * decay_steps)
@@ -147,7 +159,7 @@ class Trainer:
             if tcfg.start_weight_decay is not None else tcfg.weight_decay,
             end_wd=tcfg.end_weight_decay
             if tcfg.end_weight_decay is not None else tcfg.weight_decay,
-            wd_incr_steps=tcfg.train_iters,
+            wd_incr_steps=wd_incr_steps,
             wd_incr_style=tcfg.weight_decay_incr_style,
             use_checkpoint_opt_param_scheduler=tcfg.use_checkpoint_opt_param_scheduler,
             override_opt_param_scheduler=tcfg.override_opt_param_scheduler,
@@ -167,16 +179,31 @@ class Trainer:
             try:
                 from torch.utils.tensorboard import SummaryWriter
 
-                self._tb_writer = SummaryWriter(tcfg.tensorboard_dir)
+                self._tb_writer = SummaryWriter(
+                    tcfg.tensorboard_dir, max_queue=tcfg.tensorboard_queue_size
+                )
             except Exception:
                 self._tb_writer = None
         if tcfg.wandb_logger:
             try:
-                from megatron_llm_tpu.training.wandb_logger import WandbTBShim
+                from megatron_llm_tpu.training.wandb_logger import (
+                    WandBConfig,
+                    WandbTBShim,
+                )
 
-                self._tb_writer = WandbTBShim(self._tb_writer)
+                wcfg = WandBConfig(
+                    project=tcfg.wandb_project or "megatron_llm_tpu",
+                    entity=tcfg.wandb_entity,
+                    id=tcfg.wandb_id,
+                    resume=tcfg.wandb_resume,
+                    api_key=tcfg.wandb_api_key,
+                )
+                self._tb_writer = WandbTBShim(self._tb_writer, wcfg)
             except Exception:
                 pass
+        if self._tb_writer is not None and tcfg.log_world_size_to_tensorboard:
+            # ref: --log_world_size_to_tensorboard (training.py:590)
+            self._tb_writer.add_scalar("world-size", len(jax.devices()), 0)
 
     # ------------------------------------------------------------------
     def setup(self, rng: Optional[jax.Array] = None) -> TrainState:
@@ -294,11 +321,13 @@ class Trainer:
             state.params, state.opt_state, batch,
             jnp.float32(lr), jnp.float32(wd), dropout_rng,
         )
-        self.scheduler.step()
         state.params = params
         state.opt_state = opt_state
         state.iteration += 1
         mbs_dp = jax.tree.leaves(batch)[0].shape[1]
+        # samples mode: the scheduler advances by samples consumed this
+        # iteration (ref: training.py increment=get_current_global_batch_size)
+        self.scheduler.step(num_micro * mbs_dp if self._samples_mode else 1)
         state.consumed_train_samples += num_micro * mbs_dp
         self.num_microbatches_calc.update(state.consumed_train_samples)
         stats["lr"] = lr
@@ -431,29 +460,53 @@ class Trainer:
         # misreported) — ref: timers.log call training.py:618
         self.timers.log(["batch-generator", "train-step"],
                         normalizer=self.tcfg.log_interval)
-        if self._tb_writer is not None:
-            w = self._tb_writer
-            it = state.iteration
-            w.add_scalar("lm-loss", loss, it)
-            w.add_scalar("learning-rate", stats["lr"], it)
-            w.add_scalar("grad-norm", gnorm, it)
-            w.add_scalar("batch-size", stats["batch_size"], it)
-            if "loss_scale" in stats:
-                w.add_scalar("loss-scale", float(stats["loss_scale"]), it)
-            if "params_norm" in stats:
-                w.add_scalar("params-norm", float(stats["params_norm"]), it)
-            if "num_zeros" in stats:
-                w.add_scalar("num-zeros", int(stats["num_zeros"]), it)
-            if hasattr(w, "flush"):
-                # ref: flush_all batching (training.py:706-708)
-                w.flush()
+
+    def _tb_log(self, state, stats, elapsed):
+        """Tensorboard/wandb scalars — own cadence, independent of the
+        console log_interval (ref: training_log gates tb writes on
+        --tensorboard_log_interval per iteration, training.py:560-607)."""
+        if self._tb_writer is None or (
+            state.iteration % max(self.tcfg.tensorboard_log_interval, 1) != 0
+        ):
+            return
+        loss = float(stats["loss"])
+        gnorm = float(stats["grad_norm"])
+        w = self._tb_writer
+        it = state.iteration
+        w.add_scalar("lm-loss", loss, it)
+        w.add_scalar("learning-rate", stats["lr"], it)
+        w.add_scalar("grad-norm", gnorm, it)
+        w.add_scalar("batch-size", stats["batch_size"], it)
+        if "loss_scale" in stats:
+            w.add_scalar("loss-scale", float(stats["loss_scale"]), it)
+        if "params_norm" in stats:
+            w.add_scalar("params-norm", float(stats["params_norm"]), it)
+        if "num_zeros" in stats:
+            w.add_scalar("num-zeros", int(stats["num_zeros"]), it)
+        if self.tcfg.log_timers_to_tensorboard:
+            # ref: --log_timers_to_tensorboard writes iteration-time
+            # (training.py:598-600)
+            w.add_scalar("iteration-time", elapsed, it)
+        if self.tcfg.log_memory_to_tensorboard:
+            # ref: --log_memory_to_tensorboard (training.py:601-607);
+            # here the device allocator's live-bytes gauge
+            try:
+                ms = jax.local_devices()[0].memory_stats() or {}
+                w.add_scalar("mem-bytes-in-use",
+                             ms.get("bytes_in_use", 0), it)
+            except Exception:
+                pass
+        if hasattr(w, "flush"):
+            # ref: flush_all batching (training.py:706-708)
+            w.flush()
 
     def _save(self, state: TrainState):
         if not self.tcfg.save:
             return
         self.timers("save-checkpoint").start()
         save_checkpoint(
-            self.tcfg.save, state.iteration, state.params, state.opt_state,
+            self.tcfg.save, state.iteration, state.params,
+            None if self.tcfg.no_save_optim else state.opt_state,
             self.cfg, self.scheduler.state_dict(), state.consumed_train_samples,
         )
         self.timers("save-checkpoint").stop()
@@ -470,8 +523,14 @@ class Trainer:
         if self.cfg.hidden_dropout > 0 or self.cfg.attention_dropout > 0:
             dropout_rng = jax.random.key(tcfg.seed + 1)
 
+        def keep_going():
+            if self._samples_mode:
+                return state.consumed_train_samples < tcfg.train_samples
+            return tcfg.train_iters is None or \
+                state.iteration < tcfg.train_iters
+
         last_log_time = time.time()
-        while tcfg.train_iters is None or state.iteration < tcfg.train_iters:
+        while keep_going():
             self.timers("batch-generator").start()
             try:
                 text = next(data_iter)
@@ -508,6 +567,7 @@ class Trainer:
 
             if state.iteration % tcfg.log_interval == 0:
                 self._training_log(state, stats, elapsed)
+            self._tb_log(state, stats, elapsed)
 
             if (
                 tcfg.eval_interval
@@ -518,6 +578,16 @@ class Trainer:
                 ppl = float(np.exp(min(20.0, val)))
                 print(f"validation loss at iteration {state.iteration}: "
                       f"{val:.6E} | ppl: {ppl:.4f}", flush=True)
+                if (self._tb_writer is not None
+                        and tcfg.log_validation_ppl_to_tensorboard):
+                    # ref: --log_validation_ppl_to_tensorboard
+                    # (training.py:833-839)
+                    self._tb_writer.add_scalar("lm-loss-validation", val,
+                                               state.iteration)
+                    self._tb_writer.add_scalar("lm-loss-validation-ppl", ppl,
+                                               state.iteration)
+                    if hasattr(self._tb_writer, "flush"):
+                        self._tb_writer.flush()
 
             if tcfg.save_interval and state.iteration % tcfg.save_interval == 0:
                 self._save(state)
@@ -574,10 +644,26 @@ def pretrain(
     """
     from megatron_llm_tpu.data.data_samplers import build_pretraining_data_loader
 
-    train_iters = tcfg.train_iters or 0
+    if tcfg.train_samples is not None:
+        # sample-based duration (ref: --train_samples): the train split's
+        # budget is exact; the iteration count (for eval cadence sizing)
+        # accounts for batch-size rampup
+        from megatron_llm_tpu.training.microbatches import (
+            iterations_for_samples,
+        )
+
+        train_iters = iterations_for_samples(
+            tcfg.train_samples, tcfg.global_batch_size,
+            tcfg.micro_batch_size, pcfg.data_parallel_size,
+            tcfg.rampup_batch_size,
+        )
+        train_budget = tcfg.train_samples
+    else:
+        train_iters = tcfg.train_iters or 0
+        train_budget = train_iters * tcfg.global_batch_size
     eval_iters = (train_iters // max(tcfg.eval_interval, 1) + 1) * tcfg.eval_iters
     num_samples = [
-        train_iters * tcfg.global_batch_size,
+        train_budget,
         eval_iters * tcfg.global_batch_size,
         tcfg.eval_iters * tcfg.global_batch_size,
     ]

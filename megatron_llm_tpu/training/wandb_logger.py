@@ -14,12 +14,16 @@ from typing import Optional
 
 @dataclass
 class WandBConfig:
-    """ref: WandBConfig (wandb_logger.py:13-40)."""
+    """ref: WandBConfig (wandb_logger.py:13-40) + the --wandb_id/
+    --wandb_resume/--wandb_api_key CLI knobs (ref arguments.py:512-529)."""
 
     project: str = "megatron_llm_tpu"
     name: Optional[str] = None
     entity: Optional[str] = None
     mode: str = "offline"
+    id: Optional[str] = None
+    resume: bool = False
+    api_key: Optional[str] = None
 
 
 class WandbTBShim:
@@ -31,9 +35,14 @@ class WandbTBShim:
         try:
             import wandb
 
+            if cfg.api_key:
+                import os
+
+                os.environ.setdefault("WANDB_API_KEY", cfg.api_key)
             self._run = wandb.init(
                 project=cfg.project, name=cfg.name, entity=cfg.entity,
-                mode=cfg.mode,
+                mode=cfg.mode, id=cfg.id,
+                resume="must" if cfg.resume else None,
             )
         except Exception:
             self._run = None

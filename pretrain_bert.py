@@ -49,10 +49,17 @@ def main(argv=None):
     from megatron_llm_tpu.training.trainer import Trainer
 
     p = build_base_parser()
-    p.add_argument("--masked_lm_prob", type=float, default=0.15)
+    # --mask_prob is the reference spelling (arguments.py:885)
+    p.add_argument("--masked_lm_prob", "--mask_prob", type=float,
+                   default=0.15)
     p.add_argument("--short_seq_prob", type=float, default=0.1)
     p.add_argument("--no_binary_head", action="store_true")
     args = p.parse_args(argv)
+    if args.train_data_path or args.valid_data_path or args.test_data_path:
+        raise SystemExit(
+            "--train_data_path/--valid_data_path/--test_data_path are "
+            "GPT-family knobs; this entry point uses --data_path + --split"
+        )
 
     from megatron_llm_tpu.parallel.mesh import (
         maybe_initialize_distributed,

@@ -52,6 +52,11 @@ def main(argv=None):
     p.add_argument("--biencoder_shared_query_context_model",
                    action="store_true")
     args = p.parse_args(argv)
+    if args.train_data_path or args.valid_data_path or args.test_data_path:
+        raise SystemExit(
+            "--train_data_path/--valid_data_path/--test_data_path are "
+            "GPT-family knobs; this entry point uses --data_path + --split"
+        )
 
     from megatron_llm_tpu.parallel.mesh import (
         maybe_initialize_distributed,

@@ -141,3 +141,61 @@ def test_get_batch_eod_masks():
     # loss masked at eod positions
     np.testing.assert_array_equal(np.asarray(batch["loss_mask"][0, 0]),
                                   [1, 0, 1, 0, 1])
+
+
+# ---------------------------------------------------------------------------
+# Sample-based durations (ref: --train_samples/--lr_decay_samples/
+# --lr_warmup_samples, training.py:120-141 — VERDICT r4 flag-surface work)
+# ---------------------------------------------------------------------------
+
+
+def test_iterations_for_samples_constant():
+    from megatron_llm_tpu.training.microbatches import iterations_for_samples
+
+    # 100 samples at gbs 8 -> ceil(100/8) = 13
+    assert iterations_for_samples(100, 8, 2, 4) == 13
+    assert iterations_for_samples(96, 8, 2, 4) == 12
+
+
+def test_iterations_for_samples_rampup_matches_simulation():
+    from megatron_llm_tpu.training.microbatches import (
+        build_num_microbatches_calculator,
+        iterations_for_samples,
+    )
+
+    target, rampup = 5000, (4, 4, 1000)  # 4 -> 16 in steps of 4
+    got = iterations_for_samples(target, 16, 2, 2, rampup)
+    calc = build_num_microbatches_calculator(16, 2, 2, rampup)
+    consumed = iters = 0
+    while consumed < target:
+        consumed += calc.get_current_global_batch_size()
+        iters += 1
+        calc.update(consumed, consistency_check=False)
+    assert got == iters
+
+
+def test_trainer_samples_mode_stops_and_steps_in_samples():
+    from megatron_llm_tpu.training.trainer import Trainer
+
+    cfg = tiny_config()
+    model = LlamaModel(cfg)
+    tcfg = TrainConfig(
+        micro_batch_size=2, global_batch_size=2, lr=1e-3, min_lr=1e-4,
+        train_samples=7, lr_decay_samples=6, lr_warmup_samples=2,
+        lr_decay_style="linear", log_interval=1000,
+    )
+    trainer = Trainer(model, tcfg, ParallelConfig(num_microbatches=1))
+    state = trainer.setup()
+    rng = np.random.RandomState(0)
+    trainer.train_data_iterator = [
+        rng.randint(0, 256, (1, 2, cfg.seq_length + 1)).astype(np.int32)
+        for _ in range(10)
+    ]
+    state = trainer.train(state)
+    # 2 samples/iter against a 7-sample budget: stops after 4 iterations
+    assert state.iteration == 4
+    assert state.consumed_train_samples == 8
+    # the scheduler advanced in SAMPLES, not iterations
+    assert trainer.scheduler.num_steps == 8
+    # past lr_decay_samples=6 -> annealed to min_lr
+    assert trainer.scheduler.get_lr() == pytest.approx(tcfg.min_lr)
