@@ -110,6 +110,39 @@ def run_train(seq, iters):
     return tok_per_sec, mfu, n_params
 
 
+def run_decode(b, gen=512, prompt=64):
+    """KV-cached greedy decode tok/s on the bench model served in bf16
+    (the b=1 row is ~74% of the weight-streaming roofline after the
+    flat-GLU decode layout; VERDICT r4 #6)."""
+    from megatron_llm_tpu.inference.generation import generate_tokens
+
+    import dataclasses
+
+    cfg = dataclasses.replace(make_cfg(1024), params_dtype=jnp.bfloat16)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    max_len = prompt + gen
+    tokens = jax.random.randint(jax.random.key(1), (b, max_len), 0, 32000)
+    lengths = jnp.full((b,), prompt, jnp.int32)
+
+    def once():
+        out = generate_tokens(
+            model, params, tokens, lengths, prefill_len=prompt,
+            termination_id=None, use_eod_for_early_termination=False,
+        )
+        import numpy as np
+
+        np.asarray(out.tokens)  # host sync (axon: the real barrier)
+
+    once()  # compile
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        once()
+        best = min(best, time.perf_counter() - t0)
+    return b * gen / best
+
+
 def flash_vs_xla_ratio():
     """fwd+bwd time ratio XLA-attention / Pallas-flash at the bench seq
     length (b2 keeps the XLA path's fp32 score tensor under HBM; measured
@@ -173,6 +206,8 @@ def main():
     tok4, mfu4, _ = run_train(4096, args.iters)
     tok8, mfu8, _ = run_train(8192, max(args.iters // 2, 5))
     ratio = flash_vs_xla_ratio()
+    dec1 = run_decode(1)
+    dec8 = run_decode(8)
     achieved = tok1 * 6 * n_params
     baseline = 890.0 * 6 * 7.0e9  # A100 anchor, BASELINE.md
     print(json.dumps({
@@ -182,7 +217,8 @@ def main():
             f"(FLOP-normalized vs A100 7B anchor); "
             f"seq 4096: {tok4:.0f} tok/s, MFU {mfu4:.1%}; "
             f"seq 8192: {tok8:.0f} tok/s, MFU {mfu8:.1%}; "
-            f"flash-vs-XLA fwd+bwd speedup {ratio:.2f}x"
+            f"flash-vs-XLA fwd+bwd speedup {ratio:.2f}x; "
+            f"greedy decode {dec1:.0f} tok/s @b1, {dec8:.0f} @b8"
         ),
         "value": round(tok1, 1),
         "unit": "tokens/sec/chip",
@@ -194,6 +230,8 @@ def main():
             "tok_s_seq8192": round(tok8, 1),
             "mfu_seq8192": round(mfu8, 4),
             "flash_vs_xla_fwd_bwd_speedup": round(ratio, 2),
+            "decode_tok_s_b1": round(dec1, 1),
+            "decode_tok_s_b8": round(dec8, 1),
         },
     }))
 

@@ -1,0 +1,78 @@
+#!/bin/bash
+# Fine-tune GPT / Llama / Falcon on a TPU mesh.
+# Mirror of the reference preset (ref: examples/finetune.sh:62-109) in this
+# framework's spelling: one host process drives the whole jax.sharding.Mesh
+# (no torchrun/nproc rank plumbing), and the mesh layout is dp x pp x cp x tp.
+#
+# Usage: MODEL=llama2 SIZE=7 TP=8 PP=1 bash examples/finetune.sh
+set -euo pipefail
+
+MODEL=${MODEL:-llama2}          # gpt | llama | llama2 | codellama | falcon
+SIZE=${SIZE:-7}                 # model size in B params (llama: 7/13/34/70)
+TP=${TP:-8}                     # tensor parallel degree
+PP=${PP:-1}                     # pipeline parallel degree
+CP=${CP:-1}                     # context parallel (ring attention) degree
+MICRO_BATCH=${MICRO_BATCH:-2}
+GLOBAL_BATCH=${GLOBAL_BATCH:-1000}
+DATA_PATH=${DATA_PATH:?set DATA_PATH to your .bin/.idx prefix}
+CHECKPOINT_PATH=${CHECKPOINT_PATH:-./checkpoints/${MODEL}-${SIZE}b-tp${TP}-pp${PP}}
+TENSORBOARD_PATH=${TENSORBOARD_PATH:-${CHECKPOINT_PATH}/logging}
+
+LR="3e-4"
+if (( SIZE > 13 )); then LR="1.5e-4"; fi
+
+case "$MODEL" in
+  falcon)
+    TOKENIZER=FalconTokenizer
+    EXTRA_ARGS="--parallel_attn"
+    SEQ_LEN=2048
+    ;;
+  llama|llama2|codellama)
+    TOKENIZER=SentencePieceTokenizer
+    TOKENIZER_MODEL=${TOKENIZER_MODEL:?set TOKENIZER_MODEL to tokenizer.model}
+    EXTRA_ARGS="--tokenizer_model $TOKENIZER_MODEL --use_rms_norm
+                --glu_activation swiglu --no_tie_embed_logits"
+    if [[ $MODEL == llama ]]; then
+      SEQ_LEN=2048; EXTRA_ARGS="$EXTRA_ARGS --layernorm_epsilon 1e-6"
+    elif [[ $MODEL == llama2 ]]; then
+      SEQ_LEN=4096; EXTRA_ARGS="$EXTRA_ARGS --layernorm_epsilon 1e-5"
+    else
+      SEQ_LEN=16384; EXTRA_ARGS="$EXTRA_ARGS --rope_theta 1e6"
+    fi
+    ;;
+  gpt)
+    TOKENIZER=GPT2BPETokenizer
+    EXTRA_ARGS="--num_layers 4 --hidden_size 512 --num_attention_heads 8
+                --vocab_file ${VOCAB_FILE:?} --merges_file ${MERGES_FILE:?}"
+    SEQ_LEN=2048
+    ;;
+  *) echo "MODEL must be gpt|llama|llama2|codellama|falcon"; exit 1 ;;
+esac
+
+# The reference's CUDA-fusion toggles (--no_bias_gelu_fusion etc.) are
+# subsumed by XLA and accepted as no-ops; selective recompute maps 1:1.
+# Long sequences: add --context_parallel_size (ring attention) — the axis
+# the reference lacks.
+python finetune.py \
+  --model_name "$MODEL" --model_size "$SIZE" \
+  --tensor_model_parallel_size "$TP" \
+  --pipeline_model_parallel_size "$PP" \
+  --context_parallel_size "$CP" \
+  --sequence_parallel \
+  --use_distributed_optimizer \
+  --micro_batch_size "$MICRO_BATCH" --global_batch_size "$GLOBAL_BATCH" \
+  --data_path $DATA_PATH \
+  --tokenizer_type "$TOKENIZER" \
+  --seq_length "$SEQ_LEN" --max_position_embeddings "$SEQ_LEN" \
+  --use_flash_attn --recompute_granularity selective \
+  --bf16 \
+  --train_iters 10000 \
+  --lr "$LR" --min_lr 1e-6 --lr_decay_style cosine --lr_warmup_iters 2000 \
+  --weight_decay 0.1 --clip_grad 1.0 \
+  --adam_beta1 0.9 --adam_beta2 0.95 --adam_eps 1e-5 \
+  --hidden_dropout 0.0 --attention_dropout 0.0 \
+  --position_embedding_type rotary --rope_scaling_factor 1.0 \
+  --log_interval 1 --save_interval 50 --eval_interval 50 --eval_iters 10 \
+  --save "$CHECKPOINT_PATH" --load "$CHECKPOINT_PATH" --use_checkpoint_args \
+  --tensorboard_dir "$TENSORBOARD_PATH" --log_timers_to_tensorboard \
+  $EXTRA_ARGS "$@"

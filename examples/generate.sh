@@ -1,0 +1,17 @@
+#!/bin/bash
+# Serve a trained checkpoint over the REST generation API
+# (ref: the run_text_generation_server entry; here inference/server.py,
+# same /api request schema + static UI).
+#
+# Usage: CHECKPOINT_PATH=./checkpoints/llama2-7b TOKENIZER_MODEL=tok.model \
+#        bash examples/generate.sh
+set -euo pipefail
+
+CHECKPOINT_PATH=${CHECKPOINT_PATH:?set CHECKPOINT_PATH}
+PORT=${PORT:-5000}
+
+python tools/run_text_generation_server.py \
+  --load "$CHECKPOINT_PATH" \
+  --port "$PORT" \
+  ${TOKENIZER_MODEL:+--tokenizer_model "$TOKENIZER_MODEL"} \
+  "$@"

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Pretrain BERT-base (ref: examples/pretrain_bert.sh) on TPU.
+set -euo pipefail
+
+DATA_PATH=${DATA_PATH:?set DATA_PATH to your sentence-level .bin/.idx prefix}
+CHECKPOINT_PATH=${CHECKPOINT_PATH:-./checkpoints/bert-base}
+VOCAB_FILE=${VOCAB_FILE:?set VOCAB_FILE to bert-vocab.txt}
+
+python pretrain_bert.py \
+  --num_layers 24 \
+  --hidden_size 1024 \
+  --num_attention_heads 16 \
+  --micro_batch_size 4 \
+  --global_batch_size 8 \
+  --seq_length 512 \
+  --max_position_embeddings 512 \
+  --train_iters 2000000 \
+  --lr_decay_iters 990000 \
+  --save "$CHECKPOINT_PATH" \
+  --load "$CHECKPOINT_PATH" \
+  --data_path $DATA_PATH \
+  --vocab_file "$VOCAB_FILE" \
+  --tokenizer_type BertWordPieceLowerCase \
+  --split 949,50,1 \
+  --lr 0.0001 \
+  --min_lr 1.0e-5 \
+  --lr_decay_style linear \
+  --lr_warmup_fraction .01 \
+  --weight_decay 1e-2 \
+  --clip_grad 1.0 \
+  --mask_prob 0.15 \
+  --log_interval 100 \
+  --save_interval 10000 \
+  --eval_interval 1000 \
+  --eval_iters 10 \
+  --bf16 "$@"

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Pretrain the "345M" GPT preset (ref: examples/pretrain_gpt.sh) on TPU.
+# The reference's --data_impl mmap / --distributed_backend nccl /
+# --activations_checkpoint_method flags are subsumed or descoped with
+# explanations by the parser (megatron_llm_tpu/arguments.py).
+set -euo pipefail
+
+DATA_PATH=${DATA_PATH:?set DATA_PATH to your .bin/.idx prefix}
+CHECKPOINT_PATH=${CHECKPOINT_PATH:-./checkpoints/gpt-345m}
+
+python finetune.py \
+  --model_name gpt \
+  --num_layers 24 \
+  --hidden_size 1024 \
+  --num_attention_heads 16 \
+  --micro_batch_size 4 \
+  --global_batch_size 8 \
+  --seq_length 1024 \
+  --max_position_embeddings 1024 \
+  --train_iters 500000 \
+  --lr_decay_iters 320000 \
+  --save "$CHECKPOINT_PATH" \
+  --load "$CHECKPOINT_PATH" \
+  --data_path $DATA_PATH \
+  --tokenizer_type GPT2BPETokenizer \
+  --vocab_file "${VOCAB_FILE:-gpt2-vocab.json}" \
+  --merge_file "${MERGES_FILE:-gpt2-merges.txt}" \
+  --split 949,50,1 \
+  --lr 0.00015 \
+  --min_lr 1.0e-5 \
+  --lr_decay_style cosine \
+  --weight_decay 1e-2 \
+  --clip_grad 1.0 \
+  --lr_warmup_fraction .01 \
+  --recompute_granularity full \
+  --use_flash_attn \
+  --log_interval 100 \
+  --save_interval 10000 \
+  --eval_interval 1000 \
+  --eval_iters 10 \
+  --bf16 "$@"

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Pretrain T5-large-ish (ref: examples/pretrain_t5.sh) on TPU.
+set -euo pipefail
+
+DATA_PATH=${DATA_PATH:?set DATA_PATH to your sentence-level .bin/.idx prefix}
+CHECKPOINT_PATH=${CHECKPOINT_PATH:-./checkpoints/t5}
+VOCAB_FILE=${VOCAB_FILE:?set VOCAB_FILE to bert-vocab.txt}
+
+python pretrain_t5.py \
+  --num_layers 12 \
+  --hidden_size 768 \
+  --num_attention_heads 12 \
+  --kv_channels 64 \
+  --ffn_hidden_size 3072 \
+  --encoder_seq_length 512 \
+  --decoder_seq_length 128 \
+  --micro_batch_size 16 \
+  --global_batch_size 16 \
+  --max_position_embeddings 512 \
+  --train_iters 1000000 \
+  --lr_decay_iters 1000000 \
+  --save "$CHECKPOINT_PATH" \
+  --load "$CHECKPOINT_PATH" \
+  --data_path $DATA_PATH \
+  --vocab_file "$VOCAB_FILE" \
+  --vocab_extra_ids 100 \
+  --split 949,50,1 \
+  --lr 0.0001 \
+  --min_lr 1.0e-5 \
+  --lr_decay_style linear \
+  --lr_warmup_fraction .01 \
+  --weight_decay 1e-2 \
+  --clip_grad 1.0 \
+  --mask_prob 0.15 \
+  --log_interval 100 \
+  --save_interval 10000 \
+  --eval_interval 1000 \
+  --eval_iters 10 \
+  --bf16 "$@"

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Release gate: golden-logit parity vs the HuggingFace implementation
+# (ref: verify_correctness.py:107-122 + tests/test_llama_weights.py:104-106).
+#
+# Hermetic (CI) form — random small HF model, same converter code path:
+#   bash examples/verify.sh
+# Real-weights form — point HF_DIR at a Llama/Falcon HF checkpoint dir:
+#   HF_DIR=/path/to/Llama-2-7b-hf bash examples/verify.sh
+# Expectation: avg max-abs logit error <= 1e-3 (fp32). On drift, rerun with
+# DUMP=1 to localize the first layer that diverges.
+set -euo pipefail
+
+ARGS=(--model "${MODEL:-llama}" --tolerance "${TOLERANCE:-1e-3}")
+if [[ -n "${HF_DIR:-}" ]]; then ARGS+=(--hf_dir "$HF_DIR"); fi
+if [[ -n "${DUMP:-}" ]]; then ARGS+=(--dump_layer_errors); fi
+
+python verify_correctness.py "${ARGS[@]}" "$@"

@@ -199,16 +199,16 @@ for _f in ("--img_h", "--img_w", "--num_channels", "--num_classes",
         "vision model family is descoped (legacy in the reference; see "
         "the README descope list)"
     )
-# REALM embedding-index machinery — legacy in the reference; the biencoder
-# model + ORQA eval live in tasks/ with their own readers.
+# Residual REALM machinery — the embedding-index BUILD path is
+# implemented (tools/build_retrieval_index.py + data/realm_index.py);
+# these remaining knobs are legacy.
 for _f in ("--bert_load", "--ict_load", "--ict_head_size",
-           "--block_data_path", "--embedding_path", "--indexer_batch_size",
-           "--indexer_log_interval", "--retriever_report_topk_accuracies",
+           "--block_data_path", "--retriever_report_topk_accuracies",
            "--retriever_score_scaling"):
     DESCOPED_FLAGS[_f] = (
-        "REALM embedding-index machinery is descoped (legacy); the "
-        "biencoder model and ORQA eval live under tasks/ "
-        "(tasks/orqa, tests/test_msdp_orqa.py)"
+        "legacy REALM knob; the retrieval-index build path is "
+        "tools/build_retrieval_index.py (--embedding_path/--indexer_*) "
+        "and ORQA eval lives under tasks/"
     )
 
 # Reference flags owned by a specific entry script's parser rather than the
@@ -224,8 +224,14 @@ ENTRY_SCRIPT_FLAGS = {
     "--biencoder_projection_dim": ("pretrain_ict.py", "tasks/main.py"),
     "--biencoder_shared_query_context_model": ("pretrain_ict.py",
                                                "tasks/main.py"),
-    "--evidence_data_path": ("tasks/main.py",),
-    "--retriever_seq_length": ("tasks/main.py",),
+    "--evidence_data_path": ("tasks/main.py",
+                             "tools/build_retrieval_index.py"),
+    "--embedding_path": ("tasks/main.py",
+                         "tools/build_retrieval_index.py"),
+    "--indexer_batch_size": ("tools/build_retrieval_index.py",),
+    "--indexer_log_interval": ("tools/build_retrieval_index.py",),
+    "--retriever_seq_length": ("tasks/main.py",
+                               "tools/build_retrieval_index.py"),
 }
 
 
@@ -345,6 +351,10 @@ def build_base_parser() -> argparse.ArgumentParser:
     # context parallelism (ring attention over the sequence axis) — a
     # beyond-reference long-context axis; see ParallelConfig.
     g.add_argument("--context_parallel_size", type=int, default=1)
+    # pipeline backward remat policy (see ParallelConfig.pipeline_remat);
+    # "none"/"dots" give 1F1B-class FLOPs when per-stage HBM allows
+    g.add_argument("--pipeline_remat", default="tick",
+                   choices=["tick", "dots", "none"])
 
     g = p.add_argument_group("validation")  # ref :870-877
     g.add_argument("--eval_iters", type=int, default=100)
@@ -526,6 +536,7 @@ def args_to_configs(args, padded_vocab_size: int):
         sequence_parallel=args.sequence_parallel,
         use_distributed_optimizer=args.use_distributed_optimizer,
         num_microbatches=num_micro,
+        pipeline_remat=args.pipeline_remat,
     )
 
     tcfg = TrainConfig(

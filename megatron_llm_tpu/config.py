@@ -175,10 +175,25 @@ class ParallelConfig:
     use_distributed_optimizer: bool = False
     # Number of microbatches for pipelining / gradient accumulation.
     num_microbatches: int = 1
+    # Pipeline backward rematerialization policy — the memory/FLOP trade
+    # 1F1B exists to manage (ref: schedules.py:606-722 trains WITHOUT
+    # recomputing stage internals):
+    #   "tick" (default): jax.checkpoint every scan tick; backward keeps
+    #     only the (b,s,h) boundary carry per tick and recomputes stage
+    #     internals (~+1 forward of FLOPs — the memory-minimal choice);
+    #   "dots":  checkpoint with the dots-saveable policy; matmul outputs
+    #     are kept, only elementwise ops recompute (1F1B-class FLOPs at
+    #     intermediate memory);
+    #   "none":  no remat; AD stashes every tick's internals (1F1B-class
+    #     FLOPs, highest memory — pick when per-stage HBM allows).
+    # Measured FLOPs/memory per policy: docs/PIPELINE_MEMORY.md.
+    pipeline_remat: str = "tick"
 
     def __post_init__(self):
         if self.tensor_parallel_size == 1 and self.sequence_parallel:
             object.__setattr__(self, "sequence_parallel", False)
+        assert self.pipeline_remat in ("tick", "dots", "none"), \
+            self.pipeline_remat
 
     @property
     def world_size(self) -> int:

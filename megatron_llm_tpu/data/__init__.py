@@ -16,3 +16,10 @@ from megatron_llm_tpu.data.data_samplers import (  # noqa: F401
     MegatronPretrainingSampler,
     build_pretraining_data_loader,
 )
+from megatron_llm_tpu.data.orqa_wiki_dataset import (  # noqa: F401
+    OpenRetrievalEvidenceDataset,
+)
+from megatron_llm_tpu.data.realm_index import (  # noqa: F401
+    MIPSIndex,
+    OpenRetrievalDataStore,
+)

@@ -1,0 +1,51 @@
+"""Open-retrieval wiki evidence dataset.
+
+Parity target: ref megatron/data/orqa_wiki_dataset.py —
+`OpenRetrievalEvidenceDataset` (:122-178) reading the DPR-format evidence
+TSV (`id \t text \t title`) and serving per-row samples for the indexer
+job. The reference tokenizes eagerly into fixed-length id/type/pad arrays
+for its torch DataLoader; here rows stay text until the embedding batch is
+formed (the biencoder's `embed_text` tokenizes host-side, one compiled
+shape per batch — tasks/orqa/evaluate.py's convention), so the dataset is
+a thin indexable view over the TSV.
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import List, Tuple
+
+
+class OpenRetrievalEvidenceDataset:
+    """ref: OpenRetrievalEvidenceDataset (orqa_wiki_dataset.py:122-178)."""
+
+    def __init__(self, datapath: str, name: str = "evidence"):
+        self.name = name
+        self.samples = self.process_samples_from_single_path(datapath)
+        print(f" > loaded {len(self.samples)} evidence rows from "
+              f"{datapath}", flush=True)
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, idx: int) -> dict:
+        row_id, text, title = self.samples[idx]
+        return {"row_id": row_id, "text": text, "title": title}
+
+    @staticmethod
+    def process_samples_from_single_path(
+        filename: str,
+    ) -> List[Tuple[int, str, str]]:
+        """ref :164-178: skip the header row; the DPR convention keeps
+        ids 1-based in-file."""
+        rows = []
+        with open(filename, encoding="utf-8") as f:
+            reader = csv.reader(f, delimiter="\t")
+            for i, row in enumerate(reader):
+                if i == 0 and row and row[0] in ("id", "﻿id"):
+                    continue  # header
+                if len(row) < 2:
+                    continue
+                title = row[2] if len(row) > 2 else ""
+                rows.append((int(row[0]), row[1], title))
+        return rows

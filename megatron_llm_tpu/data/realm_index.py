@@ -1,0 +1,184 @@
+"""Persistent retrieval-embedding store + exact MIPS index.
+
+Parity target: ref megatron/data/realm_index.py —
+`OpenRetreivalDataStore` (:17-116; rank-sharded pickle shards, merge) and
+`FaissMIPSIndex` (:118-216; faiss flat inner-product search). TPU-first
+departures:
+
+- shards are .npz (ids + embeddings matrices), merged by concatenation —
+  no pickle, no faiss dependency;
+- search is EXACT chunked MIPS on the accelerator: (Q, d) @ (d, chunk)
+  with a running `lax.top_k` merge, so the (Q, N) score matrix never
+  materializes and evidence streams through the device one chunk at a
+  time — the same design the ORQA evaluator proved out
+  (tasks/orqa/evaluate.py), factored here so prebuilt indexes and
+  on-the-fly evaluation share one implementation.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class OpenRetrievalDataStore:
+    """row_id -> embedding store with rank-sharded writes
+    (ref: OpenRetreivalDataStore realm_index.py:17-116)."""
+
+    def __init__(self, embedding_path: str, load_from_path: bool = True,
+                 rank: Optional[int] = None):
+        # np.savez appends ".npz" to extension-less paths; normalize here
+        # so save and load always agree on one file name
+        if not embedding_path.endswith(".npz"):
+            embedding_path += ".npz"
+        self.embedding_path = os.path.abspath(embedding_path)
+        if rank is None:
+            try:
+                import jax
+
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+        self.rank = rank
+        self.embed_data: Dict[int, np.ndarray] = {}
+        if load_from_path and os.path.exists(self.embedding_path):
+            self.load_from_file()
+
+    # -- ref :37-48 -------------------------------------------------------
+    def state(self) -> dict:
+        return {"embed_data": self.embed_data}
+
+    def clear(self):
+        """Free the embedding data (ref :42-48)."""
+        self.embed_data = {}
+
+    # -- ref :50-72 -------------------------------------------------------
+    def load_from_file(self):
+        with np.load(self.embedding_path) as z:
+            ids, embeds = z["ids"], z["embeds"]
+        self.embed_data = {int(i): e for i, e in zip(ids, embeds)}
+        print(f"> loaded {len(self.embed_data)} embeddings from "
+              f"{self.embedding_path}", flush=True)
+
+    def add_block_data(self, row_ids, block_embeds,
+                       allow_overwrite: bool = False):
+        """Bulk-add (n,) ids + (n, d) embeddings (ref :61-72 adds one at a
+        time; vectorized here)."""
+        row_ids = np.atleast_1d(np.asarray(row_ids))
+        block_embeds = np.atleast_2d(np.asarray(block_embeds, np.float32))
+        for rid, emb in zip(row_ids, block_embeds):
+            rid = int(rid)
+            if not allow_overwrite and rid in self.embed_data:
+                raise ValueError(f"duplicate row id {rid}")
+            self.embed_data[rid] = emb
+
+    # -- ref :74-116 ------------------------------------------------------
+    def _shard_path(self, rank: int) -> str:
+        return f"{self.embedding_path}.shard{rank}.npz"
+
+    def save_shard(self):
+        """Write this process's shard (ref :74-84)."""
+        os.makedirs(os.path.dirname(self.embedding_path) or ".",
+                    exist_ok=True)
+        ids = np.asarray(sorted(self.embed_data), np.int64)
+        embeds = np.stack([self.embed_data[int(i)] for i in ids]) \
+            if len(ids) else np.zeros((0, 0), np.float32)
+        np.savez(self._shard_path(self.rank), ids=ids, embeds=embeds)
+
+    def merge_shards_and_save(self):
+        """Concatenate every shard into the final store and remove the
+        shards (ref :86-116). Call from one process after a barrier."""
+        ids_all, emb_all = [], []
+        shards = sorted(glob.glob(f"{self.embedding_path}.shard*.npz"))
+        for path in shards:
+            with np.load(path) as z:
+                if z["ids"].size:
+                    ids_all.append(z["ids"])
+                    emb_all.append(z["embeds"])
+        ids = np.concatenate(ids_all) if ids_all else np.zeros(0, np.int64)
+        if len(set(ids.tolist())) != len(ids):
+            raise ValueError("duplicate row ids across shards")
+        embeds = np.concatenate(emb_all) if emb_all else \
+            np.zeros((0, 0), np.float32)
+        np.savez(self.embedding_path, ids=ids, embeds=embeds)
+        for path in shards:
+            os.remove(path)
+        print(f"> merged {len(shards)} shards -> {len(ids)} embeddings at "
+              f"{self.embedding_path}", flush=True)
+
+
+class MIPSIndex:
+    """Exact maximum-inner-product search on the accelerator
+    (ref: FaissMIPSIndex realm_index.py:118-216 — flat IP index; here the
+    'index' is just the (N, d) matrix and search is chunked matmul+top_k,
+    exact by construction where faiss-flat is exact by configuration)."""
+
+    def __init__(self, embed_size: int, embed_data=None,
+                 chunk_rows: int = 1 << 20):
+        self.embed_size = embed_size
+        self.chunk_rows = chunk_rows
+        self.ids = np.zeros(0, np.int64)
+        self.embeds = np.zeros((0, embed_size), np.float32)
+        if embed_data is not None:
+            self.add_embed_data(embed_data)
+
+    def reset_index(self):
+        """ref :165-175."""
+        self.ids = np.zeros(0, np.int64)
+        self.embeds = np.zeros((0, self.embed_size), np.float32)
+
+    def add_embed_data(self, all_embed_data):
+        """Accepts an OpenRetrievalDataStore, its state() dict, or a
+        row_id -> embedding dict (ref :186-203)."""
+        if isinstance(all_embed_data, OpenRetrievalDataStore):
+            data = all_embed_data.embed_data
+        elif isinstance(all_embed_data, dict) and "embed_data" in all_embed_data:
+            data = all_embed_data["embed_data"]
+        else:
+            data = all_embed_data
+        if not data:
+            return
+        ids = np.asarray(sorted(data), np.int64)
+        embeds = np.stack([np.asarray(data[int(i)], np.float32)
+                           for i in ids])
+        assert embeds.shape[1] == self.embed_size, embeds.shape
+        self.ids = np.concatenate([self.ids, ids])
+        self.embeds = np.concatenate([self.embeds, embeds])
+
+    def __len__(self):
+        return len(self.ids)
+
+    def search_mips_index(self, query_embeds, top_k: int,
+                          reconstruct: bool = False):
+        """(Q, d) queries -> (scores (Q, k), ids (Q, k)) — or (scores,
+        embeddings (Q, k, d)) when reconstruct (ref :205-216). Chunked
+        over the evidence axis with a running top-k merge."""
+        import jax
+        import jax.numpy as jnp
+
+        q = jnp.asarray(np.asarray(query_embeds, np.float32))
+        n = self.embeds.shape[0]
+        k = min(top_k, n)
+
+        @jax.jit
+        def chunk_topk(q, ev):
+            s = q @ ev.T
+            return jax.lax.top_k(s, min(k, s.shape[-1]))
+
+        best_s = np.full((q.shape[0], 0), -np.inf, np.float32)
+        best_i = np.zeros((q.shape[0], 0), np.int64)
+        for lo in range(0, n, self.chunk_rows):
+            ev = jnp.asarray(self.embeds[lo:lo + self.chunk_rows])
+            s, i = chunk_topk(q, ev)
+            best_s = np.concatenate([best_s, np.asarray(s)], axis=1)
+            best_i = np.concatenate(
+                [best_i, np.asarray(i, np.int64) + lo], axis=1)
+            order = np.argsort(-best_s, axis=1)[:, :k]
+            best_s = np.take_along_axis(best_s, order, axis=1)
+            best_i = np.take_along_axis(best_i, order, axis=1)
+        if reconstruct:
+            return best_s, self.embeds[best_i]
+        return best_s, self.ids[best_i]

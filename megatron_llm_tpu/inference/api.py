@@ -32,6 +32,51 @@ from megatron_llm_tpu.inference.tokenization import (
 # likewise holds strong refs and compares identity.
 _PP_SCORE_CACHE: dict = {}
 _PP_PARAMS_CACHE: dict = {}  # {"model": .., "mesh": .., "src": .., "out": ..}
+_PP_DECODE_CACHE: dict = {}  # (model, mesh, statics) -> jitted decode
+
+# Above this model size the pp>1 decode path keeps params stage-sharded
+# and pipelines tokens through the stage ring (parallel/pipeline.py
+# make_pipelined_decode_fn) instead of paying reshard's pp x per-device
+# param memory (VERDICT r4 #4; ref analogue: the batch*seqlen dispatch of
+# text_generation/forward_step.py:61-73).
+import os as _os
+
+PP_DECODE_RESHARD_LIMIT_BYTES = int(_os.environ.get(
+    "MEGATRON_TPU_PP_RESHARD_LIMIT_BYTES", 2 << 30
+))
+
+
+def _params_nbytes(params) -> int:
+    return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(params))
+
+
+def _pp_decode_fn(model, ctx, statics):
+    key = (model, ctx.mesh, statics)
+    if key not in _PP_DECODE_CACHE:
+        # bound the executable cache: shape statics vary per request
+        # (max_len is 64-bucketed by the caller); FIFO-evict beyond 8
+        while len(_PP_DECODE_CACHE) >= 8:
+            _PP_DECODE_CACHE.pop(next(iter(_PP_DECODE_CACHE)))
+        from megatron_llm_tpu.config import ParallelConfig
+        from megatron_llm_tpu.parallel.pipeline import (
+            make_pipelined_decode_fn,
+        )
+
+        (prefill_len, max_len, greedy, top_k, top_p, temperature,
+         vocab_size, termination_id, use_eod_early,
+         return_log_probs) = statics
+        pcfg = ParallelConfig(pipeline_parallel_size=ctx.pp,
+                              tensor_parallel_size=ctx.tp,
+                              context_parallel_size=ctx.cp)
+        _PP_DECODE_CACHE[key] = jax.jit(make_pipelined_decode_fn(
+            model, pcfg, ctx, prefill_len=prefill_len, max_len=max_len,
+            greedy=greedy, top_k=top_k, top_p=top_p,
+            temperature=temperature, vocab_size=vocab_size,
+            termination_id=termination_id,
+            use_eod_for_early_termination=use_eod_early,
+            return_log_probs=return_log_probs,
+        ))
+    return _PP_DECODE_CACHE[key]
 
 
 def _pp_score_fn(model, ctx):
@@ -113,6 +158,7 @@ def generate_and_post_process(
     from megatron_llm_tpu.parallel.mesh import get_context
 
     ctx = get_context()
+    pp_pipelined = False
     if ctx is not None and ctx.pp > 1:
         if tokens_to_generate == 0:
             import jax.numpy as jnp
@@ -128,7 +174,15 @@ def generate_and_post_process(
                 tokenizer, tokens, lengths, return_segments=True
             )
             return texts, segments, lp, tokens
-        params = _pp_serving_params(model, ctx, params)
+        # big models stay stage-sharded and decode through the ring;
+        # small ones pay reshard once and use the plain engine (the
+        # pipelined path lacks colon-newline and top-p-decay knobs)
+        if (ctx.cp == 1
+                and _params_nbytes(params) > PP_DECODE_RESHARD_LIMIT_BYTES
+                and not prevent_newline_after_colon and top_p_decay == 0.0):
+            pp_pipelined = True
+        else:
+            params = _pp_serving_params(model, ctx, params)
 
     if tokens_to_generate == 0:
         # score-only mode (ref: api.py:48-56 -> score_and_return...)
@@ -162,6 +216,48 @@ def generate_and_post_process(
     # prompt is teacher-forced by the decode loop (bounded compile shapes)
     min_len = int(np.min(lengths))
     prefill_len = max(1, (min_len // 64) * 64) if min_len >= 64 else min_len
+
+    if pp_pipelined:
+        b, max_len = tokens.shape
+        nm = ctx.pp
+        toks_in = np.asarray(tokens)
+        lens_in = np.asarray(lengths)
+        pad_rows = (-b) % nm
+        if pad_rows:  # batch must split evenly into pp round-robin groups
+            toks_in = np.concatenate(
+                [toks_in, np.repeat(toks_in[-1:], pad_rows, 0)])
+            lens_in = np.concatenate(
+                [lens_in, np.repeat(lens_in[-1:], pad_rows, 0)])
+        # bucket max_len to 64 so the compiled-executable cache stays
+        # small across varying request lengths (extra columns are decoded
+        # then trimmed by out_lengths below)
+        max_len_b = -(-max_len // 64) * 64
+        if max_len_b > max_len:
+            toks_in = np.concatenate(
+                [toks_in,
+                 np.zeros((toks_in.shape[0], max_len_b - max_len),
+                          toks_in.dtype)], axis=1)
+        greedy = top_k_sampling == 1 or rng is None
+        statics = (
+            prefill_len, max_len_b, greedy, top_k_sampling, top_p_sampling,
+            temperature, tokenizer.vocab_size, tokenizer.eod,
+            use_eod_token_for_early_termination, return_output_log_probs,
+        )
+        dec = _pp_decode_fn(model, ctx, statics)
+        import jax.numpy as jnp
+
+        out_toks, out_lens, out_lps = dec(
+            params, jnp.asarray(toks_in), jnp.asarray(lens_in), rng
+        )
+        out_tokens = np.asarray(out_toks)[:b, :max_len]
+        out_lengths = np.minimum(np.asarray(out_lens)[:b],
+                                 lengths + tokens_to_generate)
+        texts, segments = detokenize_generations(
+            tokenizer, out_tokens, out_lengths, return_segments=True
+        )
+        lp = (np.asarray(out_lps)[:b, : max_len - 1]
+              if return_output_log_probs else None)
+        return texts, segments, lp, out_tokens
 
     out = generate_tokens(
         model,

@@ -37,6 +37,45 @@ class GenerateOutput(NamedTuple):
     log_probs: Optional[jnp.ndarray]  # (b, max_len - 1) fp32 or None
 
 
+def select_next_token(
+    logits,  # (b, V) fp32-castable
+    prev_token,  # (b,) int32
+    step_rng,
+    cur_top_p,
+    *,
+    greedy: bool,
+    top_k: int,
+    top_p: float,
+    temperature: float,
+    vocab_size=None,
+    prevent_newline_after_colon_ids=None,
+):
+    """One sampling decision (ref: generation.py:174-237 sampling block) —
+    shared by the single-mesh decode loop and the pp-pipelined decode."""
+    logits = logits.astype(jnp.float32)
+    if prevent_newline_after_colon_ids is not None:
+        # ref :191: disable "\n" right after ":"
+        colon_id, newline_id = prevent_newline_after_colon_ids
+        hit = prev_token == colon_id
+        logits = jnp.where(
+            hit[:, None]
+            & (jnp.arange(logits.shape[-1]) == newline_id)[None, :],
+            NEG_INF, logits,
+        )
+    if vocab_size is not None and vocab_size < logits.shape[-1]:
+        pad = jnp.arange(logits.shape[-1]) >= vocab_size
+        logits = jnp.where(pad[None, :], NEG_INF, logits)
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if temperature != 1.0:
+        logits = logits / temperature
+    if top_k > 1:
+        logits = modify_logits_for_top_k(logits, top_k)
+    elif top_p > 0.0:
+        logits = modify_logits_for_top_p(logits, cur_top_p)
+    return jax.random.categorical(step_rng, logits, axis=-1).astype(jnp.int32)
+
+
 def score_tokens(model, params, tokens: jnp.ndarray) -> jnp.ndarray:
     """Log-probs of each provided next token (ref:
     score_and_return_on_first_stage generation.py:20-86).
@@ -88,6 +127,10 @@ def generate_tokens(
     if rng is None:
         rng = jax.random.key(0)  # unused on the greedy path
 
+    # one-time decode weight layout (GLU matvec bandwidth; see
+    # prepare_decode_params) — outside the token loop by construction
+    if hasattr(model, "prepare_decode_params"):
+        params = model.prepare_decode_params(params)
     caches = model.init_kv_caches(b, max_len)
 
     log_probs = jnp.zeros((b, max_len - 1), jnp.float32)
@@ -109,28 +152,12 @@ def generate_tokens(
     last_logits = logits[:, -1]  # predicts position prefill_len
 
     def select_token(logits, t, prev_token, step_rng, cur_top_p):
-        logits = logits.astype(jnp.float32)
-        if prevent_newline_after_colon_ids is not None:
-            # ref :191: disable "\n" right after ":"
-            colon_id, newline_id = prevent_newline_after_colon_ids
-            hit = prev_token == colon_id
-            logits = jnp.where(
-                hit[:, None]
-                & (jnp.arange(logits.shape[-1]) == newline_id)[None, :],
-                NEG_INF, logits,
-            )
-        if vocab_size is not None and vocab_size < logits.shape[-1]:
-            pad = jnp.arange(logits.shape[-1]) >= vocab_size
-            logits = jnp.where(pad[None, :], NEG_INF, logits)
-        if greedy:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if temperature != 1.0:
-            logits = logits / temperature
-        if top_k > 1:
-            logits = modify_logits_for_top_k(logits, top_k)
-        elif top_p > 0.0:
-            logits = modify_logits_for_top_p(logits, cur_top_p)
-        return jax.random.categorical(step_rng, logits, axis=-1).astype(jnp.int32)
+        return select_next_token(
+            logits, prev_token, step_rng, cur_top_p, greedy=greedy,
+            top_k=top_k, top_p=top_p, temperature=temperature,
+            vocab_size=vocab_size,
+            prevent_newline_after_colon_ids=prevent_newline_after_colon_ids,
+        )
 
     # ---- single-token decode steps ---------------------------------------
     # carry: (t, tokens, caches, last_logits, log_probs, done, gen_lengths,
@@ -303,6 +330,8 @@ def beam_search(
         jnp.int32
     )
 
+    if hasattr(model, "prepare_decode_params"):
+        params = model.prepare_decode_params(params)
     caches = model.init_kv_caches(beam_size, max_len)
     logits, caches = model.forward(
         params, tokens[:, :prompt_length], kv_caches=caches

@@ -75,6 +75,24 @@ class GPTModel:
         loss_mask = loss_mask.astype(jnp.float32)
         return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
 
+    def prepare_decode_params(self, params: dict) -> dict:
+        """Decode-layout view of the params: the stacked GLU up/gate
+        weight (L, h, 2, f) flattened to (L, h, 2f) — a row-major bitcast
+        done ONCE before the decode loop, so every single-token MLP matvec
+        streams the weight at full GEMV bandwidth instead of tiling the
+        2-sized gate/up axis into sublanes (~33% of HBM bandwidth, traced
+        on v5e; mlp_block dispatches on the weight's rank)."""
+        if not self.cfg.glu_activation:
+            return params
+        params = dict(params)
+        layers = dict(params["layers"])
+        mlp = dict(layers["mlp"])
+        w1 = mlp["w1"]
+        mlp["w1"] = w1.reshape(w1.shape[0], w1.shape[1], -1)
+        layers["mlp"] = mlp
+        params["layers"] = layers
+        return params
+
     def init_kv_caches(self, batch_size: int, max_len: int) -> dict:
         """Per-layer stacked KV cache for incremental decode
         (ref: InferenceParams forward_step.py:17-41)."""

@@ -32,6 +32,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# The kernels run the online softmax in the exp2 domain (scores pre-scaled
+# by log2(e)): the TPU transcendental unit computes exp2 natively, so
+# exp(x) = exp2(x * log2e) folds one multiply per score cell into the GEMM
+# scale. lse crosses the kernel boundary in NATURAL log units.
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
 
 # swept on a real v5e (r4, b6/g16/d128 @ seq 4096 and b8 @ 1024):
 # 1024/1024 beats 512/1024 by ~10-12% fwd+bwd at both lengths (and
@@ -104,19 +110,24 @@ def _choose_block(size: int, requested: int, qpk: int = 1):
     return b if b >= 8 and size % b == 0 else None
 
 
-def _masked_scores(q_ref, k_ref, i, j, *, causal, block_q, block_k, qpk, d,
+def _masked_scores(q_ref, k_ref, i, j, *, masked, block_q, block_k, qpk, d,
                    sm_scale):
-    """Recompute the scaled, causal-masked score block — the ONE definition
-    shared by the forward and both backward kernels so fwd probabilities and
-    bwd recompute can never desynchronize. Returns (rows, block_k) fp32."""
+    """Recompute the scaled score block in the exp2 domain — the ONE
+    definition shared by the forward and both backward kernels so fwd
+    probabilities and bwd recompute can never desynchronize. `masked` is a
+    TRACE-TIME flag: callers split their grid step into interior
+    (fully-below-diagonal, no iota/select work) and diagonal-straddling
+    branches, so the causal mask costs VPU time only on the ~1/num_blocks
+    of blocks that actually straddle the diagonal.
+    Returns (rows, block_k) fp32, scaled by sm_scale * log2(e)."""
     rows = block_q * qpk
     qb = q_ref[:].reshape(rows, d)
     kb = k_ref[:].reshape(block_k, d)
     sc = jax.lax.dot_general(
         qb, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * sm_scale
-    if causal:
+    ) * (sm_scale * LOG2E)
+    if masked:
         q_pos = i * block_q + (
             jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0) // qpk
         )
@@ -135,7 +146,8 @@ def _masked_scores(q_ref, k_ref, i, j, *, causal, block_q, block_k, qpk, d,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, causal, block_q, block_k, qpk, d, num_k_blocks, sm_scale):
+                *, causal, block_q, block_k, qpk, d, num_k_blocks, sm_scale,
+                split_diag=True):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -145,24 +157,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    if causal:
-        # skip fully-masked K blocks (k block start > last q position)
-        run = (j * block_k) <= (i * block_q + block_q - 1)
-    else:
-        run = j >= 0  # always true, but traced
-
-    @pl.when(run)
-    def _compute():
-        # rows: (pos, head), head fastest
+    def _accum(masked):
+        # rows: (pos, head), head fastest; running stats in exp2 domain
         sc = _masked_scores(
-            q_ref, k_ref, i, j, causal=causal, block_q=block_q,
+            q_ref, k_ref, i, j, masked=masked, block_q=block_q,
             block_k=block_k, qpk=qpk, d=d, sm_scale=sm_scale,
         )
         m_prev = m_scr[:]  # (rows, 1)
         m_cur = jnp.max(sc, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(sc - m_new)  # (rows, block_k)
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(sc - m_new)  # (rows, block_k)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
             p.astype(v_ref.dtype), v_ref[:].reshape(block_k, d),
@@ -171,6 +176,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_scr[:] = m_new
         l_scr[:] = l_new
 
+    if causal:
+        # skip fully-masked K blocks (k block start > last q position);
+        # apply the mask only on diagonal-straddling blocks — interior
+        # blocks (last col <= first q row) run the maskless branch.
+        # (split_diag=False under the interpreter: the two-branch grid
+        # step trips a vma check in the Pallas HLO interpreter.)
+        run = (j * block_k) <= (i * block_q + block_q - 1)
+        if split_diag:
+            interior = (j * block_k + block_k - 1) <= (i * block_q)
+
+            @pl.when(run & interior)
+            def _compute_interior():
+                _accum(False)
+
+            @pl.when(run & ~interior)
+            def _compute_diagonal():
+                _accum(True)
+        else:
+            @pl.when(run)
+            def _compute():
+                _accum(True)
+    else:
+        @pl.when(j >= 0)  # always true; pl.when so the interpreter's vma
+        def _compute():   # unification wraps the body (interpret mode)
+            _accum(False)
+
     @pl.when(j == num_k_blocks - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
@@ -178,8 +209,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             1, block_q, qpk * d
         )
         # rows-major (rows, 1) layout: Mosaic can't shape-cast the lane dim
-        # into sublanes, so lse lives as (bg, s*qpk, 1) end to end
-        lse_ref[0] = m_scr[:] + jnp.log(l)
+        # into sublanes, so lse lives as (bg, s*qpk, 1) end to end.
+        # m is in exp2 units; emit NATURAL-log lse (the kernel ABI).
+        lse_ref[0] = m_scr[:] * LN2 + jnp.log(l)
 
 
 def _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret=False):
@@ -200,6 +232,7 @@ def _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret=False):
     kernel = functools.partial(
         _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
         qpk=qpk, d=d, num_k_blocks=num_k_blocks, sm_scale=sm_scale,
+        split_diag=not interpret,
     )
     grid = (b * g, num_q_blocks, num_k_blocks)
 
@@ -235,6 +268,11 @@ def _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret=False):
             pltpu.VMEM((block_q * qpk, 1), jnp.float32),
             pltpu.VMEM((block_q * qpk, d), jnp.float32),
         ],
+        # (bg, q) grid steps are independent; only the k dim carries the
+        # online-softmax accumulator state
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4), lse
@@ -247,7 +285,7 @@ def _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret=False):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    acc_scr, *, causal, block_q, block_k, qpk, d,
-                   num_k_blocks, sm_scale):
+                   num_k_blocks, sm_scale, split_diag=True):
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # k block
 
@@ -255,20 +293,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = ((j * block_k) <= (i * block_q + block_q - 1)) if causal else j >= 0
-
-    @pl.when(run)
-    def _compute():
+    def _accum(masked):
         rows = block_q * qpk
         kb = k_ref[:].reshape(block_k, d)
         vb = v_ref[:].reshape(block_k, d)
         dob = do_ref[:].reshape(rows, d)
 
         sc = _masked_scores(
-            q_ref, k_ref, i, j, causal=causal, block_q=block_q,
+            q_ref, k_ref, i, j, masked=masked, block_q=block_q,
             block_k=block_k, qpk=qpk, d=d, sm_scale=sm_scale,
         )
-        p = jnp.exp(sc - lse_ref[0])  # exact probs via saved logsumexp
+        # exact probs via saved logsumexp; sc is exp2-domain, the saved
+        # lse is natural-log — rescale the (rows, 1) vector, not the block
+        p = jnp.exp2(sc - lse_ref[0] * LOG2E)
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -277,6 +314,27 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         acc_scr[:] = acc_scr[:] + jax.lax.dot(
             ds.astype(kb.dtype), kb, preferred_element_type=jnp.float32
         )
+
+    if causal:
+        run = (j * block_k) <= (i * block_q + block_q - 1)
+        if split_diag:
+            interior = (j * block_k + block_k - 1) <= (i * block_q)
+
+            @pl.when(run & interior)
+            def _compute_interior():
+                _accum(False)
+
+            @pl.when(run & ~interior)
+            def _compute_diagonal():
+                _accum(True)
+        else:
+            @pl.when(run)
+            def _compute():
+                _accum(True)
+    else:
+        @pl.when(j >= 0)
+        def _compute():
+            _accum(False)
 
     @pl.when(j == num_k_blocks - 1)
     def _finalize():
@@ -287,7 +345,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, causal, block_q,
-                    block_k, qpk, d, num_q_blocks, sm_scale):
+                    block_k, qpk, d, num_q_blocks, sm_scale,
+                    split_diag=True):
     j = pl.program_id(1)  # k block
     i = pl.program_id(2)  # q block
 
@@ -296,21 +355,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    # causal: q blocks strictly before this k block contribute nothing
-    run = ((i * block_q + block_q - 1) >= (j * block_k)) if causal else i >= 0
-
-    @pl.when(run)
-    def _compute():
+    def _accum(masked):
         rows = block_q * qpk
         qb = q_ref[:].reshape(rows, d)
         vb = v_ref[:].reshape(block_k, d)
         dob = do_ref[:].reshape(rows, d)
 
         sc = _masked_scores(
-            q_ref, k_ref, i, j, causal=causal, block_q=block_q,
+            q_ref, k_ref, i, j, masked=masked, block_q=block_q,
             block_k=block_k, qpk=qpk, d=d, sm_scale=sm_scale,
         )
-        p = jnp.exp(sc - lse_ref[0])  # (rows, block_k)
+        p = jnp.exp2(sc - lse_ref[0] * LOG2E)  # (rows, block_k)
         # dv += P^T dO
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
@@ -326,6 +381,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    if causal:
+        # q blocks strictly before this k block contribute nothing
+        run = (i * block_q + block_q - 1) >= (j * block_k)
+        if split_diag:
+            interior = (j * block_k + block_k - 1) <= (i * block_q)
+
+            @pl.when(run & interior)
+            def _compute_interior():
+                _accum(False)
+
+            @pl.when(run & ~interior)
+            def _compute_diagonal():
+                _accum(True)
+        else:
+            @pl.when(run)
+            def _compute():
+                _accum(True)
+    else:
+        @pl.when(i >= 0)
+        def _compute():
+            _accum(False)
 
     @pl.when(i == num_q_blocks - 1)
     def _finalize():
@@ -388,12 +465,16 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
         functools.partial(
             _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
             qpk=qpk, d=d, num_k_blocks=num_k_blocks, sm_scale=sm_scale,
+            split_diag=not interpret,
         ),
         grid=(b * g, num_q_blocks, num_k_blocks),
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),
         out_shape=_out_struct((b * g, s, qpk * d), q.dtype, qf),
         scratch_shapes=[pltpu.VMEM((block_q * qpk, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
@@ -409,6 +490,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
         functools.partial(
             _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
             qpk=qpk, d=d, num_q_blocks=num_q_blocks, sm_scale=sm_scale,
+            split_diag=not interpret,
         ),
         grid=(b * g, num_k_blocks, num_q_blocks),
         in_specs=col_specs,
@@ -424,6 +506,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 

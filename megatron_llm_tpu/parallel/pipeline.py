@@ -25,9 +25,13 @@ The TPU design collapses all of that into one differentiable program:
 Schedule note: AD produces a GPipe-style schedule (all-forward then
 all-backward per scan transpose) rather than interleaved 1F1B — but the
 thing 1F1B exists to bound (per-stage live activation memory,
-schedules.py:606-722) is bounded here differently and harder: every tick
-body is `jax.checkpoint`ed, so the backward keeps ONLY the (b, s, h)
-boundary carry per tick and recomputes stage internals. 1F1B keeps <=pp
+schedules.py:606-722) is bounded here differently and harder: by default
+every tick body is `jax.checkpoint`ed, so the backward keeps ONLY the
+(b, s, h) boundary carry per tick and recomputes stage internals.
+`ParallelConfig.pipeline_remat` ("tick"/"dots"/"none") trades that memory
+floor back for 1F1B-class FLOPs when per-stage HBM allows — measured in
+docs/PIPELINE_MEMORY.md ("dots" hits the FLOP floor at intermediate
+memory). 1F1B keeps <=pp
 in-flight stashes of a stage's FULL internal activations (~tens of b*s*h
 per layer chunk); this design keeps (num_micro + pp - 1) single-boundary
 tensors. For any real depth/width the boundary stash is the smaller
@@ -317,10 +321,21 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 )
                 return (state, sums, denoms), None
 
-            # backward keeps only the tick-boundary carries; stage internals
-            # are recomputed (the TPU answer to deallocate_output_tensor +
-            # 1F1B's bounded stash, schedules.py:36-88)
-            tick = jax.checkpoint(tick, prevent_cse=False)
+            # Backward memory policy (ParallelConfig.pipeline_remat):
+            # "tick" keeps only the tick-boundary carries and recomputes
+            # stage internals (the TPU answer to deallocate_output_tensor +
+            # 1F1B's bounded stash, schedules.py:36-88); "dots" keeps matmul
+            # outputs (1F1B-class FLOPs, intermediate memory); "none" keeps
+            # everything (1F1B-class FLOPs, what the reference's no-remat
+            # 1F1B pays in memory). Measured: docs/PIPELINE_MEMORY.md.
+            remat = getattr(pcfg, "pipeline_remat", "tick")
+            if remat == "tick":
+                tick = jax.checkpoint(tick, prevent_cse=False)
+            elif remat == "dots":
+                tick = jax.checkpoint(
+                    tick, prevent_cse=False,
+                    policy=jax.checkpoint_policies.checkpoint_dots,
+                )
 
             # carries become stage-varying inside the loop; mark the zero
             # initials as varying so the scan carry types are stable
@@ -531,6 +546,440 @@ def make_pipelined_score_fn(model, pcfg, ctx: ParallelContext):
         return banked[-1][:, :, :-1]
 
     return score_fn
+
+
+def make_pipelined_decode_fn(model, pcfg, ctx: ParallelContext, *,
+                             prefill_len: int, max_len: int,
+                             num_micro: int | None = None,
+                             greedy: bool = True, top_k: int = 0,
+                             top_p: float = 0.0, temperature: float = 1.0,
+                             vocab_size: int | None = None,
+                             termination_id: int | None = None,
+                             use_eod_for_early_termination: bool = True,
+                             return_log_probs: bool = False):
+    """Token-by-token KV-cached decode ON the stage-sharded mesh — no
+    `reshard_params_for_inference` pp x param-memory blowup (VERDICT r4
+    #4; ref: the pipelined inference forwards of
+    text_generation/forward_step.py:153-204).
+
+    Round-robin schedule: the batch is split into `num_micro` (default pp)
+    groups; at every tick each stage advances a DIFFERENT group by one
+    token, boundaries rotate by `lax.ppermute`, and the last stage samples
+    the next token and sends it back to stage 0 — with num_micro == pp the
+    returned token arrives exactly when stage 0 next serves that group, so
+    steady-state has zero bubble. Each stage holds ONLY its layers' KV
+    cache shard: per-device cache AND param memory stay 1/pp.
+
+    Mechanics mirrored from the training/score pipelines: collectives stay
+    OUT of lax.conds (XLA-CPU), operands are pcast stage-varying up front,
+    and fill/drain garbage ticks write their cache columns into a scratch
+    region past max_len (offset redirect) so no per-tick buffer select is
+    needed.
+
+    Returns decode(params, tokens (b, max_len), lengths (b,), rng) ->
+    (tokens, gen_lengths, log_probs|None), semantics matching
+    `generation.generate_tokens` (greedy path exact).
+    """
+    from megatron_llm_tpu.inference.generation import select_next_token
+
+    cfg = model.cfg
+    mesh = ctx.mesh
+    pp = pcfg.pipeline_parallel_size
+    assert ctx.cp == 1, "pipelined decode: cp axis unsupported"
+    nm = num_micro or pp
+    assert nm >= pp, "num_micro must be >= pp (token return latency)"
+    steps = max_len - prefill_len - 1  # decode rounds after the seed
+    assert steps >= 0
+    cache_T = max_len + max(prefill_len, 1)  # scratch tail for garbage ticks
+    has_rope = cfg.position_embedding_type == "rotary"
+
+    def decode_fn(params, tokens, lengths, rng=None):
+        tokens = tokens.astype(jnp.int32)
+        b, _ = tokens.shape
+        assert b % nm == 0, (b, nm)
+        b_m = b // nm
+        toks_g = tokens.reshape(nm, b_m, max_len)
+        lens_g = lengths.astype(jnp.int32).reshape(nm, b_m)
+        if rng is None:
+            rng = jax.random.key(0)
+        rng = jax.random.key_data(rng).astype(jnp.uint32)  # pcast-able
+
+        if has_rope:
+            rope_table = precompute_rope(
+                cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
+                cfg.rope_scaling_factor,
+            )
+        else:
+            rope_table = jnp.zeros((1,), jnp.float32)
+
+        aux_params = {
+            "embedding": params["embedding"],
+            "final_norm": params["final_norm"],
+        }
+        if not cfg.tie_embed_logits:
+            aux_params["lm_head"] = params["lm_head"]
+
+        boundary_dtype = _boundary_dtype(cfg)
+
+        def shard(layers_local, aux, toks, lens, rng_u):
+            from megatron_llm_tpu.parallel.mesh import manual_region
+
+            with manual_region():
+                return _decode_shard_body(layers_local, aux, toks, lens,
+                                          rng_u)
+
+        def _decode_shard_body(layers_local, aux, toks, lens, rng_u):
+            stage = jax.lax.axis_index(STAGE_AXIS)
+            L_loc = jax.tree.leaves(layers_local)[0].shape[0]
+            _, aux, rope, (toks, lens, rng_u), _ = _mark_varying(
+                1, aux, rope_table, (toks, lens, rng_u), layers_local
+            )
+            rope_t = rope if has_rope else None
+            base_rng = jax.random.wrap_key_data(rng_u)
+            pv = lambda x: jax.lax.pcast(  # noqa: E731
+                x, (STAGE_AXIS,), to="varying"
+            )
+
+            def head(hidden):  # (b_m, s, h) -> (b_m, s, V) fp32
+                h = apply_norm(
+                    hidden.astype(cfg.compute_dtype), aux["final_norm"], cfg
+                )
+                return lm_logits(aux, cfg, h).astype(jnp.float32)
+
+            def run_stage(inp, kc, vc, m, off):
+                """One stage pass of (b_m, s) tokens at cache offset
+                `off` for microbatch m; returns (out, kc, vc)."""
+                kc_m = jax.lax.dynamic_index_in_dim(kc, m, 1, False)
+                vc_m = jax.lax.dynamic_index_in_dim(vc, m, 1, False)
+                out, new_caches = transformer_stack(
+                    layers_local, cfg, inp, rope_t, None, None, None, True,
+                    kv_caches={"k": kc_m, "v": vc_m, "offset": off},
+                    layer_offset=stage * L_loc,
+                )
+                kc = jax.lax.dynamic_update_index_in_dim(
+                    kc, new_caches["k"], m, 1
+                )
+                vc = jax.lax.dynamic_update_index_in_dim(
+                    vc, new_caches["v"], m, 1
+                )
+                return out, kc, vc
+
+            kshape = (L_loc, nm, b_m, cache_T, cfg.num_query_groups,
+                      cfg.head_dim)
+            kc = pv(jnp.zeros(kshape, cfg.compute_dtype))
+            vc = pv(jnp.zeros(kshape, cfg.compute_dtype))
+
+            # ---- prefill: GPipe ticks over full-prefix chunks ----------
+            pids_prefix = jnp.arange(prefill_len, dtype=jnp.int32)[None]
+
+            def prefill_tick(carry, t):
+                state, kc, vc, seeds, lps, toks_b = carry
+                m = jnp.clip(t - stage, 0, nm - 1)
+                valid = (t >= stage) & (t - stage <= nm - 1)
+                chunk = jax.lax.dynamic_index_in_dim(toks, m, 0, False)
+                chunk = chunk[:, :prefill_len]
+                emb = embed_tokens(aux, cfg, chunk, pids_prefix, None,
+                                   True).astype(boundary_dtype)
+                inp = jnp.where(stage == 0, emb, state).astype(
+                    cfg.compute_dtype
+                )
+                # garbage ticks redirect their cache writes past max_len
+                off = jnp.where(valid, 0, max_len)
+                out, kc, vc = run_stage(inp, kc, vc, m, off)
+                out = out.astype(boundary_dtype)
+
+                valid_last = (stage == pp - 1) & (t >= pp - 1) & \
+                    (t - (pp - 1) <= nm - 1)
+                m_out = jnp.clip(t - (pp - 1), 0, nm - 1)
+                step_rng = jax.random.fold_in(base_rng, m_out)
+                toks_out = jax.lax.dynamic_index_in_dim(toks, m_out, 0,
+                                                        False)
+
+                # the head (final norm + full-vocab logits) runs ONLY on
+                # the last stage, same lax.cond pattern as the training
+                # tick's head_losses — no collectives inside the cond
+                def last_stage_work(h):
+                    if return_log_probs:
+                        logits = head(h)  # (b_m, prefill, V)
+                        lp_all = jax.nn.log_softmax(logits, axis=-1)
+                        lp_pref = jnp.take_along_axis(
+                            lp_all[:, :-1],
+                            toks_out[:, 1:prefill_len, None], axis=-1,
+                        ).squeeze(-1)  # (b_m, prefill-1)
+                        last_logits = logits[:, -1]
+                    else:
+                        lp_pref = pv(jnp.zeros((b_m, prefill_len - 1),
+                                               jnp.float32))
+                        last_logits = head(h[:, -1:])[:, 0]
+                    # seed token at position prefill_len (teacher-forced
+                    # if the row's prompt extends past the prefix)
+                    sample = select_next_token(
+                        last_logits, toks_out[:, prefill_len - 1],
+                        step_rng, jnp.float32(top_p),
+                        greedy=greedy, top_k=top_k, top_p=top_p,
+                        temperature=temperature, vocab_size=vocab_size,
+                    )
+                    if prefill_len < max_len:
+                        started = jax.lax.dynamic_index_in_dim(
+                            lens, m_out, 0, False) <= prefill_len
+                        chosen = jnp.where(started, sample,
+                                           toks_out[:, prefill_len])
+                    else:
+                        chosen = sample
+                    lp_seed = jnp.take_along_axis(
+                        jax.nn.log_softmax(last_logits, -1),
+                        chosen[:, None], axis=-1,
+                    ).squeeze(-1) if return_log_probs else \
+                        pv(jnp.zeros((b_m,), jnp.float32))
+                    return chosen, lp_pref, lp_seed
+
+                def skip_stage_work(h):
+                    return (pv(jnp.zeros((b_m,), jnp.int32)),
+                            pv(jnp.zeros((b_m, prefill_len - 1),
+                                         jnp.float32)),
+                            pv(jnp.zeros((b_m,), jnp.float32)))
+
+                chosen, lp_pref, lp_seed = jax.lax.cond(
+                    valid_last, last_stage_work, skip_stage_work, out
+                )
+                if return_log_probs:
+                    lps = jnp.where(
+                        valid_last,
+                        jax.lax.dynamic_update_slice(
+                            lps, lp_pref[None], (m_out, 0, 0)
+                        ),
+                        lps,
+                    )
+                    lps = jnp.where(
+                        valid_last,
+                        jax.lax.dynamic_update_slice(
+                            lps, lp_seed[None, :, None],
+                            (m_out, 0, prefill_len - 1),
+                        ),
+                        lps,
+                    )
+                seeds = jnp.where(
+                    valid_last,
+                    jax.lax.dynamic_update_index_in_dim(seeds, chosen,
+                                                        m_out, 0),
+                    seeds,
+                )
+                if prefill_len < max_len:
+                    toks_b = jnp.where(
+                        valid_last,
+                        jax.lax.dynamic_update_slice(
+                            toks_b, chosen[None, :, None],
+                            (m_out, 0, prefill_len),
+                        ),
+                        toks_b,
+                    )
+                state = jax.lax.ppermute(
+                    out, STAGE_AXIS,
+                    [(i, i + 1) for i in range(pp - 1)],
+                )
+                return (state, kc, vc, seeds, lps, toks_b), None
+
+            state0 = pv(jnp.zeros((b_m, prefill_len, cfg.hidden_size),
+                                  boundary_dtype))
+            seeds0 = pv(jnp.zeros((nm, b_m), jnp.int32))
+            lps0 = pv(jnp.zeros((nm, b_m, max_len - 1), jnp.float32))
+            (_, kc, vc, seeds, lps, toks), _ = jax.lax.scan(
+                prefill_tick, (state0, kc, vc, seeds0, lps0, toks),
+                jnp.arange(nm + pp - 1),
+            )
+            # ship the seed tokens to stage 0's feed buffer
+            next_tok = jax.lax.ppermute(seeds, STAGE_AXIS, [(pp - 1, 0)])
+
+            # ---- decode: round-robin single-token ticks ----------------
+            offsets0 = pv(jnp.full((nm,), prefill_len, jnp.int32))
+            state0 = pv(jnp.zeros((b_m, 1, cfg.hidden_size),
+                                  boundary_dtype))
+            done0 = pv(jnp.zeros((nm, b_m), bool))
+            glens0 = pv(jnp.full((nm, b_m), max_len, jnp.int32))
+            total = steps * nm + pp - 1
+
+            def cond(carry):
+                t = carry[0]
+                all_done = carry[-1]
+                keep = t < total
+                if termination_id is not None and \
+                        use_eod_for_early_termination:
+                    keep &= ~all_done
+                return keep
+
+            def body(carry):
+                (t, state, kc, vc, next_tok, toks_b, lps, done, glens,
+                 offsets, _) = carry
+                m = jnp.mod(t - stage, nm)
+                valid = (t >= stage) & (t - stage < steps * nm)
+                off = jax.lax.dynamic_index_in_dim(offsets, m, 0, False)
+                tok_in = jax.lax.dynamic_index_in_dim(next_tok, m, 0,
+                                                      False)
+                emb = embed_tokens(aux, cfg, tok_in[:, None], off[None,
+                                   None], None, True).astype(boundary_dtype)
+                inp = jnp.where(stage == 0, emb, state).astype(
+                    cfg.compute_dtype
+                )
+                off_w = jnp.where(valid, off, max_len)
+                out, kc, vc = run_stage(inp, kc, vc, m, off_w)
+                out = out.astype(boundary_dtype)
+                offsets = jnp.where(
+                    valid,
+                    jax.lax.dynamic_update_index_in_dim(offsets, off + 1,
+                                                        m, 0),
+                    offsets,
+                )
+
+                # last stage: sample position off+1's token for its group
+                m_l = jnp.mod(t - (pp - 1), nm)
+                valid_last = (stage == pp - 1) & (t >= pp - 1) & \
+                    (t - (pp - 1) < steps * nm)
+                pos = jax.lax.dynamic_index_in_dim(
+                    offsets, m_l, 0, False)  # off+1 (just incremented)
+                step_rng = jax.random.fold_in(
+                    base_rng, pos * nm + m_l
+                )
+                toks_m = jax.lax.dynamic_index_in_dim(toks_b, m_l, 0,
+                                                      False)
+                lens_m = jax.lax.dynamic_index_in_dim(lens, m_l, 0, False)
+                started = lens_m <= pos
+
+                # full-vocab head + sampling under lax.cond: only the
+                # last stage pays the h x V matvec per tick
+                def last_stage_work(h):
+                    logits = head(h)[:, 0]  # (b_m, V)
+                    prev = jnp.take_along_axis(
+                        toks_m,
+                        jnp.broadcast_to(jnp.maximum(pos - 1, 0),
+                                         (b_m,))[:, None],
+                        axis=1,
+                    ).squeeze(1)
+                    sample = select_next_token(
+                        logits, prev, step_rng, jnp.float32(top_p),
+                        greedy=greedy, top_k=top_k, top_p=top_p,
+                        temperature=temperature, vocab_size=vocab_size,
+                    )
+                    prompt_tok = jnp.take_along_axis(
+                        toks_m,
+                        jnp.broadcast_to(jnp.minimum(pos, max_len - 1),
+                                         (b_m,))[:, None],
+                        axis=1,
+                    ).squeeze(1)
+                    chosen = jnp.where(started, sample, prompt_tok)
+                    lp_t = jnp.take_along_axis(
+                        jax.nn.log_softmax(logits, -1), chosen[:, None],
+                        axis=-1,
+                    ).squeeze(-1) if return_log_probs else \
+                        pv(jnp.zeros((b_m,), jnp.float32))
+                    return chosen, lp_t
+
+                def skip_stage_work(h):
+                    return (pv(jnp.zeros((b_m,), jnp.int32)),
+                            pv(jnp.zeros((b_m,), jnp.float32)))
+
+                chosen, lp_t = jax.lax.cond(
+                    valid_last, last_stage_work, skip_stage_work, out
+                )
+                new_toks_m = jax.vmap(
+                    lambda row, c: jax.lax.dynamic_update_index_in_dim(
+                        row, c, jnp.minimum(pos, max_len - 1), 0
+                    )
+                )(toks_m, chosen)
+                toks_b = jnp.where(
+                    valid_last,
+                    jax.lax.dynamic_update_index_in_dim(
+                        toks_b, new_toks_m, m_l, 0
+                    ),
+                    toks_b,
+                )
+                if return_log_probs:
+                    lps_m = jax.lax.dynamic_index_in_dim(lps, m_l, 0,
+                                                         False)
+                    new_lps_m = jax.vmap(
+                        lambda row, v: jax.lax.dynamic_update_index_in_dim(
+                            row, v, jnp.minimum(pos - 1, max_len - 2), 0
+                        )
+                    )(lps_m, lp_t)
+                    lps = jnp.where(
+                        valid_last,
+                        jax.lax.dynamic_update_index_in_dim(
+                            lps, new_lps_m, m_l, 0
+                        ),
+                        lps,
+                    )
+                if termination_id is not None:
+                    done_m = jax.lax.dynamic_index_in_dim(done, m_l, 0,
+                                                          False)
+                    glens_m = jax.lax.dynamic_index_in_dim(glens, m_l, 0,
+                                                           False)
+                    done_token = (chosen == termination_id) & started
+                    just = done_token & ~done_m
+                    glens_m = jnp.where(just, pos + 1, glens_m)
+                    done_m = done_m | done_token
+                    done = jnp.where(
+                        valid_last,
+                        jax.lax.dynamic_update_index_in_dim(done, done_m,
+                                                            m_l, 0),
+                        done,
+                    )
+                    glens = jnp.where(
+                        valid_last,
+                        jax.lax.dynamic_update_index_in_dim(glens, glens_m,
+                                                            m_l, 0),
+                        glens,
+                    )
+                    all_done_local = jnp.where(
+                        stage == pp - 1, jnp.all(done), False
+                    )
+                else:
+                    all_done_local = jnp.asarray(False)
+                # collectives OUTSIDE any cond (XLA-CPU rule)
+                all_done = jax.lax.psum(
+                    all_done_local.astype(jnp.int32), STAGE_AXIS
+                ) > 0
+                chosen_bc = jnp.where(valid_last, chosen, 0)
+                tok_back = jax.lax.ppermute(chosen_bc, STAGE_AXIS,
+                                            [(pp - 1, 0)])
+                next_tok = jnp.where(
+                    (stage == 0) & (t >= pp - 1),
+                    jax.lax.dynamic_update_index_in_dim(
+                        next_tok, tok_back, m_l, 0
+                    ),
+                    next_tok,
+                )
+                state = jax.lax.ppermute(
+                    out, STAGE_AXIS,
+                    [(i, i + 1) for i in range(pp - 1)],
+                )
+                return (t + 1, state, kc, vc, next_tok, toks_b, lps, done,
+                        glens, offsets, all_done)
+
+            # all_done comes out of a psum — stage-INVARIANT, so its init
+            # must be too
+            carry = (jnp.int32(0), state0, kc, vc, next_tok, toks, lps,
+                     done0, glens0, offsets0, jnp.asarray(False))
+            carry = jax.lax.while_loop(cond, body, carry)
+            toks_b, lps, glens = carry[5], carry[6], carry[8]
+            return toks_b[None], lps[None], glens[None]
+
+        mapped = jax.shard_map(
+            shard,
+            mesh=mesh,
+            in_specs=(P(STAGE_AXIS), P(), P(), P(), P()),
+            out_specs=(P(STAGE_AXIS), P(STAGE_AXIS), P(STAGE_AXIS)),
+            axis_names={STAGE_AXIS},
+        )
+        toks_out, lps_out, glens_out = mapped(
+            params["layers"], aux_params, toks_g, lens_g, rng
+        )
+        # the last stage's bank is authoritative
+        out_tokens = toks_out[-1].reshape(b, max_len)
+        out_lens = glens_out[-1].reshape(b)
+        out_lps = lps_out[-1].reshape(b, max_len - 1) \
+            if return_log_probs else None
+        return out_tokens, out_lens, out_lps
+
+    return decode_fn
 
 
 def reshard_params_for_inference(params, ctx: ParallelContext, cfg):

@@ -39,9 +39,28 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
+def _masked_hop_with_lse(q, k_blk, v_blk, mask):
+    """One ring hop with an explicit (b, s_loc, t_loc) mask (True =
+    masked): XLA einsum path returning (o, lse) for the logsumexp merge.
+    The score block is s_loc x t_loc (per-hop, checkpointed) — the seq^2
+    buffer cp exists to avoid never materializes. Packed-document masks
+    take this path; a doc-aware Pallas kernel is a future optimization."""
+    b, s, g, qpk, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    scores = jnp.einsum("bsgqd,btgd->bgqst", q, k_blk,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask[:, None, None], NEG_INF, scores)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)  # (b, g, qpk, s)
+    # fully-masked rows: lse = -inf -> weight 0 in the merge
+    probs = jnp.exp(scores - jnp.maximum(lse, NEG_INF / 2)[..., None])
+    o = jnp.einsum("bgqst,btgd->bsgqd", probs.astype(v_blk.dtype), v_blk)
+    return o, jnp.moveaxis(lse, 3, 1)  # lse -> (b, s, g, qpk)
+
+
 def ring_self_attention(q, k, v, axis_name: str, causal: bool = True,
                         use_pallas: bool | None = None,
-                        interpret: bool = False):
+                        interpret: bool = False,
+                        doc_start=None):
     """Inside a shard_map region with the sequence sharded over
     `axis_name`: exact attention over the GLOBAL sequence.
 
@@ -56,6 +75,13 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True,
     resident (t=0) hop is the diagonal block (causal inside), later hops
     are either fully visible (owner < idx: causal=False) or fully masked
     (owner > idx: skipped before any compute).
+
+    `doc_start` (b, s_loc) int32 — GLOBAL index of each local query's
+    document start — enables --reset_attention_mask packed-document
+    training with the sequence still sharded (VERDICT r4 #5): every hop
+    builds its small block-diagonal mask from the hop's global key
+    offsets (allowed iff doc_start[i] <= j <= i) and runs the masked-hop
+    path above; above-diagonal hops are still skipped outright.
     """
     from megatron_llm_tpu.ops.flash_attention import (
         flash_attention_with_lse,
@@ -64,14 +90,24 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True,
     cp = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s, g, qpk, d = q.shape
+    if doc_start is not None:
+        assert causal, "packed-document masks imply causal attention"
+        # global positions of this shard's queries
+        q_pos = idx * s + jnp.arange(s)
 
-    def merge(carry, k_blk, v_blk, diag: bool):
+    def merge(carry, k_blk, v_blk, diag: bool, owner=None):
         """Flash the hop, fold its (o, lse) into the running (m, l, o)."""
         m, l, o = carry
-        o_h, lse_h = flash_attention_with_lse(
-            q, k_blk, v_blk, causal=diag, use_pallas=use_pallas,
-            interpret=interpret,
-        )
+        if doc_start is not None:
+            k_pos = owner * s + jnp.arange(s)  # hop's global key positions
+            hop_mask = (k_pos[None, None, :] > q_pos[None, :, None]) | \
+                (k_pos[None, None, :] < doc_start[:, :, None])
+            o_h, lse_h = _masked_hop_with_lse(q, k_blk, v_blk, hop_mask)
+        else:
+            o_h, lse_h = flash_attention_with_lse(
+                q, k_blk, v_blk, causal=diag, use_pallas=use_pallas,
+                interpret=interpret,
+            )
         m_new = jnp.maximum(m, lse_h)
         m_safe = jnp.maximum(m_new, NEG_INF / 2)
         corr = jnp.exp(m - m_safe)
@@ -96,11 +132,13 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True,
             m, l, o = jax.lax.cond(
                 owner > idx,
                 lambda kb, vb, c: c,
-                lambda kb, vb, c: merge(c, kb, vb, diag=False),
+                lambda kb, vb, c: merge(c, kb, vb, diag=False,
+                                        owner=owner),
                 k_blk, v_blk, (m, l, o),
             )
         else:
-            m, l, o = merge((m, l, o), k_blk, v_blk, diag=False)
+            m, l, o = merge((m, l, o), k_blk, v_blk, diag=False,
+                            owner=owner)
         return (k_blk, v_blk, m, l, o), None
 
     step = jax.checkpoint(step, prevent_cse=False)
@@ -111,7 +149,7 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True,
     o0 = pv(jnp.zeros((b, s, g, qpk, d), jnp.float32))
     # the resident block (t = 0, owner = idx) is the causal diagonal and
     # merges without any rotation; the scan covers the cp - 1 ring hops
-    m1, l1, o1 = merge((m0, l0, o0), k, v, diag=causal)
+    m1, l1, o1 = merge((m0, l0, o0), k, v, diag=causal, owner=idx)
     (k_f, v_f, m, l, o), _ = jax.lax.scan(
         step, (k, v, m1, l1, o1), jnp.arange(1, cp)
     )

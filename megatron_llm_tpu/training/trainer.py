@@ -59,16 +59,25 @@ class SignalHandler:
 
 
 def get_batch(text: np.ndarray, eod_token=None, reset_position_ids=False,
-              reset_attention_mask=False, eod_mask_loss=False):
+              reset_attention_mask=False, eod_mask_loss=False,
+              packed_doc_starts=False):
     """(num_micro, b, seq+1) 'text' -> model inputs
-    (ref: finetune.py get_batch :65-81 + utils.get_ltor_masks_and_position_ids)."""
+    (ref: finetune.py get_batch :65-81 + utils.get_ltor_masks_and_position_ids).
+
+    `packed_doc_starts`: emit the --reset_attention_mask mask as the O(s)
+    {"doc_start"} form instead of a dense (s, s) tensor — required under
+    context parallelism, where the dense form would force a full-sequence
+    gather (models/attention.py routes doc_start through ring attention
+    with the sequence still sharded)."""
     tokens = text[:, :, :-1]
     labels = text[:, :, 1:]
     n, b, s = tokens.shape
-    flat = tokens.reshape(n * b, s)
+    flat = jnp.asarray(tokens.reshape(n * b, s))
     attn_mask, loss_mask, position_ids = get_ltor_masks_and_position_ids(
-        jnp.asarray(flat), eod_token, reset_position_ids,
-        reset_attention_mask, eod_mask_loss,
+        flat, eod_token,
+        reset_position_ids,
+        reset_attention_mask and not packed_doc_starts,
+        eod_mask_loss,
     )
     batch = {
         "tokens": jnp.asarray(tokens),
@@ -76,6 +85,14 @@ def get_batch(text: np.ndarray, eod_token=None, reset_position_ids=False,
         "loss_mask": loss_mask.reshape(n, b, s),
         "position_ids": position_ids.reshape(n, b, s),
     }
+    if reset_attention_mask and packed_doc_starts:
+        from megatron_llm_tpu.utils.masks import get_document_starts
+
+        batch["attention_mask"] = {
+            "doc_start": get_document_starts(flat, eod_token)
+            .reshape(n, b, s)
+        }
+        return batch
     if attn_mask is not None:
         batch["attention_mask"] = attn_mask.reshape(n, b, 1, s, s)
     return batch
@@ -308,7 +325,18 @@ class Trainer:
             batch = get_batch(
                 text, self.eod_token, self.reset_position_ids,
                 self.reset_attention_mask, self.eod_mask_loss,
+                # under cp the dense mask would gather the full sequence;
+                # ship the O(s) doc-start form through ring attention
+                packed_doc_starts=self.ctx is not None and self.ctx.cp > 1,
             )
+            if (self.pcfg.pipeline_parallel_size > 1
+                    and "attention_mask" in batch):
+                raise ValueError(
+                    "pp>1 training does not support "
+                    "--reset_attention_mask (the pipelined loss has no "
+                    "attention-mask path); drop the flag or train with "
+                    "pp=1"
+                )
         lr, wd = self.scheduler.get_lr(), self.scheduler.get_wd()
         if self.ctx is not None and jax.process_count() > 1:
             # per-process rows -> global arrays sharded over `data`

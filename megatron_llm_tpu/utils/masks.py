@@ -9,6 +9,23 @@ import jax
 import jax.numpy as jnp
 
 
+def get_document_starts(tokens: jnp.ndarray, eod_token: int) -> jnp.ndarray:
+    """(b, s) int32: for each position, the index of its document's FIRST
+    token (documents delimited by eod; the eod token belongs to its
+    document). The --reset_attention_mask block-diagonal-causal mask is
+    exactly `allowed(i, j) <=> doc_start[i] <= j <= i`, so this one vector
+    carries the packed-document mask in O(s) — what ring attention ships
+    per sequence shard instead of an O(s^2) dense mask
+    (ref: utils.py:137-196)."""
+    b, s = tokens.shape
+    is_eod = (tokens == eod_token).astype(jnp.int32)
+    idx = jnp.arange(s)[None, :]
+    boundary = jnp.where(
+        jnp.pad(is_eod[:, :-1], ((0, 0), (1, 0))) == 1, idx, 0
+    )
+    return jax.lax.cummax(boundary, axis=1).astype(jnp.int32)
+
+
 def get_ltor_masks_and_position_ids(
     tokens: jnp.ndarray,  # (b, s) int
     eod_token: Optional[int] = None,
@@ -49,10 +66,11 @@ def get_ltor_masks_and_position_ids(
     else:
         position_ids = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
 
-    causal = cols > rows  # (s, s), True = masked
     if reset_attention_mask:
+        causal = cols > rows  # (s, s), True = masked
         same_doc = doc_id[:, :, None] == doc_id[:, None, :]  # (b, s, s)
         mask = (~same_doc) | causal[None]
-    else:
-        mask = jnp.broadcast_to(causal[None], (b, s, s))
-    return mask[:, None], loss_mask, position_ids
+        return mask[:, None], loss_mask, position_ids
+    # position reset WITHOUT attention reset keeps plain causal masking:
+    # return None so the flash path stays eligible
+    return None, loss_mask, position_ids

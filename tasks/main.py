@@ -60,6 +60,9 @@ def get_tasks_args(parser):
     g.add_argument("--qa_data_dev", type=str, default=None)
     g.add_argument("--qa_data_test", type=str, default=None)
     g.add_argument("--evidence_data_path", type=str, default=None)
+    # prebuilt evidence index (tools/build_retrieval_index.py output);
+    # omitted -> embed the evidence on the fly
+    g.add_argument("--embedding_path", type=str, default=None)
     g.add_argument("--retriever_seq_length", type=int, default=256)
     g.add_argument("--retriever_topk", type=int, default=20)
     g.add_argument("--match", type=str, default="string",
@@ -229,8 +232,13 @@ def _retriever_eval_main(args):
         batch_size=args.micro_batch_size,
     )
     docs = read_evidence_tsv(args.evidence_data_path)
-    print(f" > embedding {len(docs)} evidence blocks ...", flush=True)
-    evaluator.build_index(docs)
+    if args.embedding_path:
+        print(f" > loading prebuilt index {args.embedding_path} ...",
+              flush=True)
+        evaluator.load_index(docs, args.embedding_path)
+    else:
+        print(f" > embedding {len(docs)} evidence blocks ...", flush=True)
+        evaluator.build_index(docs)
     if args.qa_data_dev:
         evaluator.evaluate(args.qa_data_dev, "DEV",
                            topk=args.retriever_topk,

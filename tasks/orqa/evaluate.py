@@ -89,6 +89,26 @@ class ORQAEvaluator:
         )
         return self.evidence_emb
 
+    def load_index(self, docs: List[Tuple[object, str, str]],
+                   embedding_path: str):
+        """Use a PREBUILT embedding store (tools/build_retrieval_index.py
+        -> OpenRetrievalDataStore) instead of re-embedding the evidence —
+        the ref realm_index load path (realm_index.py:50-60)."""
+        from megatron_llm_tpu.data.realm_index import OpenRetrievalDataStore
+
+        store = OpenRetrievalDataStore(embedding_path)
+        if not store.embed_data:
+            raise FileNotFoundError(
+                f"no embedding store at {store.embedding_path} — build it "
+                "with tools/build_retrieval_index.py"
+            )
+        self.evidence_ids = [d[0] for d in docs]
+        self.all_docs = {d[0]: (d[1], d[2]) for d in docs}
+        self.evidence_emb = np.stack(
+            [store.embed_data[int(d[0])] for d in docs]
+        ).astype(np.float32)
+        return self.evidence_emb
+
     def retrieve(self, questions: List[str], topk: int = 20,
                  chunk_rows: int = 1 << 20):
         """MIPS: (Q, d) @ (d, N) + top-k (the FAISS replacement),

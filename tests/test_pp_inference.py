@@ -243,3 +243,110 @@ class TestPipelinedEval:
         finally:
             destroy_parallel()
         np.testing.assert_allclose(ref, got, rtol=2e-4)
+
+
+class TestPipelinedDecode:
+    """Round-robin KV-cached decode on the stage mesh (VERDICT r4 #4):
+    pp-trained params generate WITHOUT reshard's pp x param memory
+    (ref analogue: pipelined inference forwards,
+    text_generation/forward_step.py:153-204)."""
+
+    def _run(self, pp=2, tp=1, termination_id=None, **dec_kw):
+        from megatron_llm_tpu.inference.generation import generate_tokens
+        from megatron_llm_tpu.parallel.pipeline import (
+            make_pipelined_decode_fn,
+        )
+
+        ctx = initialize_parallel(dp=1, pp=pp, tp=tp)
+        try:
+            cfg = _cfg()
+            model = LlamaModel(cfg)
+            params, sharded = _stage_sharded(model, ctx)
+            b, max_len, prefill = 4, 32, 8
+            rng = np.random.RandomState(0)
+            tokens = np.zeros((b, max_len), np.int32)
+            lengths = np.array([8, 10, 8, 12], np.int32)
+            for i in range(b):
+                tokens[i, : lengths[i]] = rng.randint(1, 255, lengths[i])
+            pcfg = ParallelConfig(pipeline_parallel_size=pp,
+                                  tensor_parallel_size=tp)
+            dec = jax.jit(make_pipelined_decode_fn(
+                model, pcfg, ctx, prefill_len=prefill, max_len=max_len,
+                greedy=True, termination_id=termination_id,
+                return_log_probs=True, **dec_kw,
+            ))
+            out_toks, out_lens, out_lps = dec(
+                sharded, jnp.asarray(tokens), jnp.asarray(lengths)
+            )
+            ref = generate_tokens(
+                model, params, jnp.asarray(tokens), jnp.asarray(lengths),
+                prefill_len=prefill, return_log_probs=True,
+                termination_id=termination_id,
+            )
+            return ref, out_toks, out_lens, out_lps
+        finally:
+            destroy_parallel()
+
+    def test_exact_match_vs_replicated_pp2(self):
+        ref, toks, lens, lps = self._run(pp=2)
+        np.testing.assert_array_equal(np.asarray(ref.tokens),
+                                      np.asarray(toks))
+        np.testing.assert_allclose(np.asarray(ref.log_probs),
+                                   np.asarray(lps), atol=1e-5)
+
+    def test_exact_match_pp2_tp2(self):
+        ref, toks, lens, lps = self._run(pp=2, tp=2)
+        np.testing.assert_array_equal(np.asarray(ref.tokens),
+                                      np.asarray(toks))
+
+    def test_eod_termination_matches(self):
+        # pick a termination id that WILL be generated by the random model
+        ref, toks, lens, lps = self._run(pp=2, termination_id=None)
+        # find a token the reference generated, rerun with it as eod
+        gen = np.asarray(ref.tokens)[0, 10:]
+        term = int(gen[0])
+        ref2, toks2, lens2, _ = self._run(pp=2, termination_id=term)
+        np.testing.assert_array_equal(np.asarray(ref2.lengths),
+                                      np.asarray(lens2))
+
+    def test_num_micro_above_pp(self):
+        ref, toks, lens, lps = self._run(pp=2, num_micro=4)
+        np.testing.assert_array_equal(np.asarray(ref.tokens),
+                                      np.asarray(toks))
+
+    def test_api_prefers_pipelined_above_threshold(self, monkeypatch):
+        """generate_and_post_process on a pp mesh routes through the
+        stage-ring decode when the model exceeds the reshard limit."""
+        from megatron_llm_tpu.inference import api
+        from megatron_llm_tpu.tokenizer import build_tokenizer
+
+        ctx = initialize_parallel(dp=1, pp=2, tp=1)
+        try:
+            cfg = _cfg(padded_vocab_size=512)
+            model = LlamaModel(cfg)
+            params, sharded = _stage_sharded(model, ctx)
+            tok = build_tokenizer("NullTokenizer", null_vocab_size=510)
+            monkeypatch.setattr(api, "PP_DECODE_RESHARD_LIMIT_BYTES", 0)
+            called = {}
+            orig = api._pp_decode_fn
+
+            def spy(model, ctx_, statics):
+                called["yes"] = True
+                return orig(model, ctx_, statics)
+
+            monkeypatch.setattr(api, "_pp_decode_fn", spy)
+            texts, segs, lp, toks = api.generate_and_post_process(
+                model, sharded, tok, ["1 2 3 4 5 6 7 8"],
+                tokens_to_generate=8, top_k_sampling=1,
+            )
+            assert called.get("yes"), "pipelined decode path not taken"
+            # and the reshard path produces the same greedy tokens
+            monkeypatch.setattr(api, "PP_DECODE_RESHARD_LIMIT_BYTES",
+                                1 << 62)
+            texts2, _, _, toks2 = api.generate_and_post_process(
+                model, sharded, tok, ["1 2 3 4 5 6 7 8"],
+                tokens_to_generate=8, top_k_sampling=1,
+            )
+            np.testing.assert_array_equal(toks, toks2)
+        finally:
+            destroy_parallel()

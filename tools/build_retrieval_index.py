@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Build the open-retrieval evidence embedding index.
+
+The rebuild of the reference's indexer job (ref: megatron/indexer.py
+`IndexBuilder.build_and_save_index` driven by tools/create_doc_index.py):
+embed every evidence block with the biencoder's CONTEXT tower, store
+row_id -> embedding in the persistent OpenRetrievalDataStore, and merge
+per-process shards. Multi-host: each process embeds rows
+`process_index::process_count` and writes its own shard; process 0 merges.
+
+Usage:
+  python tools/build_retrieval_index.py \\
+      --evidence_data_path wiki-evidence.tsv \\
+      --embedding_path wiki-embeds.npz \\
+      --load ckpts/retriever --use_checkpoint_args \\
+      --tokenizer_type BertWordPieceLowerCase --vocab_file vocab.txt \\
+      --retriever_seq_length 256 --indexer_batch_size 128
+
+The produced store feeds tasks/main.py --task ORQA-EVAL via
+--embedding_path (skips re-embedding the evidence) and the MIPSIndex
+directly (megatron_llm_tpu/data/realm_index.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--evidence_data_path", required=True,
+                   help="DPR-format evidence tsv: id \\t text \\t title")
+    p.add_argument("--embedding_path", required=True,
+                   help="output .npz embedding store")
+    p.add_argument("--load", default=None,
+                   help="biencoder checkpoint dir (omit for a random "
+                        "model — smoke-test mode)")
+    p.add_argument("--use_checkpoint_args", action="store_true")
+    p.add_argument("--tokenizer_type", default="BertWordPieceLowerCase")
+    p.add_argument("--vocab_file", default=None)
+    p.add_argument("--tokenizer_model", default=None)
+    p.add_argument("--null_vocab_size", type=int, default=None)
+    p.add_argument("--retriever_seq_length", type=int, default=256)
+    p.add_argument("--indexer_batch_size", type=int, default=128)
+    p.add_argument("--indexer_log_interval", type=int, default=1000)
+    p.add_argument("--biencoder_projection_dim", type=int, default=0)
+    p.add_argument("--biencoder_shared_query_context_model",
+                   action="store_true")
+    # architecture (overridden by --use_checkpoint_args)
+    p.add_argument("--num_layers", type=int, default=12)
+    p.add_argument("--hidden_size", type=int, default=768)
+    p.add_argument("--num_attention_heads", type=int, default=12)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from megatron_llm_tpu.config import bert_config
+    from megatron_llm_tpu.data.orqa_wiki_dataset import (
+        OpenRetrievalEvidenceDataset,
+    )
+    from megatron_llm_tpu.data.realm_index import OpenRetrievalDataStore
+    from megatron_llm_tpu.models.biencoder import BiEncoderModel
+    from megatron_llm_tpu.tokenizer import build_tokenizer
+    from tasks.orqa.nq import tokenize_queries
+
+    tokenizer = build_tokenizer(
+        args.tokenizer_type, vocab_file=args.vocab_file,
+        tokenizer_model=args.tokenizer_model,
+        null_vocab_size=args.null_vocab_size,
+    )
+    cfg = bert_config(
+        num_layers=args.num_layers, hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        seq_length=args.retriever_seq_length,
+        padded_vocab_size=tokenizer.padded_vocab_size,
+    )
+    model = BiEncoderModel(
+        cfg, projection_dim=args.biencoder_projection_dim,
+        shared_query_context_model=args.biencoder_shared_query_context_model,
+    )
+    params = model.init(jax.random.key(0))
+    if args.load:
+        from megatron_llm_tpu.training.checkpointing import (
+            load_checkpoint,
+            load_model_config_from_checkpoint,
+        )
+
+        if args.use_checkpoint_args:
+            cfg = load_model_config_from_checkpoint(args.load, cfg)
+            model = BiEncoderModel(
+                cfg, projection_dim=args.biencoder_projection_dim,
+                shared_query_context_model=(
+                    args.biencoder_shared_query_context_model),
+            )
+            params = model.init(jax.random.key(0))
+        restored = load_checkpoint(args.load, params)
+        assert restored is not None, f"no checkpoint under {args.load}"
+        params = restored[0]
+    else:
+        print("WARNING: no --load; indexing with RANDOM weights "
+              "(smoke-test mode)", flush=True)
+
+    tower = params["shared"] if "shared" in params else params["context"]
+    embed = jax.jit(lambda toks, mask: model.embed_text(tower, toks, mask))
+
+    dataset = OpenRetrievalEvidenceDataset(args.evidence_data_path)
+    rank, world = jax.process_index(), jax.process_count()
+    my_rows = list(range(rank, len(dataset), world))
+    store = OpenRetrievalDataStore(args.embedding_path,
+                                   load_from_path=False, rank=rank)
+
+    bs = args.indexer_batch_size
+    t0 = time.time()
+    for lo in range(0, len(my_rows), bs):
+        idxs = my_rows[lo:lo + bs]
+        rows = [dataset[i] for i in idxs]
+        texts = [r["text"] for r in rows]
+        pad = bs - len(texts)
+        toks, mask, _ = tokenize_queries(
+            tokenizer, texts + [""] * pad, args.retriever_seq_length
+        )
+        emb = np.asarray(
+            embed(jnp.asarray(toks), jnp.asarray(mask)), np.float32
+        )[: len(texts)]
+        store.add_block_data([r["row_id"] for r in rows], emb)
+        if (lo // bs) % max(args.indexer_log_interval, 1) == 0:
+            done = lo + len(idxs)
+            rate = done / max(time.time() - t0, 1e-9)
+            print(f"rank {rank}: embedded {done}/{len(my_rows)} rows "
+                  f"({rate:.1f} rows/s)", flush=True)
+
+    store.save_shard()
+    if world > 1:
+        # all shards must exist before the merge
+        from megatron_llm_tpu.parallel.multihost import all_hosts_any
+
+        all_hosts_any(True)  # barrier
+    if rank == 0:
+        store.merge_shards_and_save()
+    print(f"rank {rank}: done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,21 +59,28 @@ from megatron_llm_tpu.parallel.pipeline import (  # noqa: E402
 )
 
 
-def measure(pp, num_micro, *, layers_per_stage=2, b=2, s=512, h=256,
-            ffn=512, heads=8, vocab=512):
-    cfg = tiny_config(
+def _cfg(pp, *, layers_per_stage, b, s, h, ffn, heads, vocab):
+    return tiny_config(
         num_layers=pp * layers_per_stage, hidden_size=h,
         num_attention_heads=heads, num_attention_heads_kv=heads,
         ffn_hidden_size=ffn, seq_length=s, max_position_embeddings=s,
         padded_vocab_size=vocab, compute_dtype=jnp.bfloat16,
         params_dtype=jnp.float32,
     )
+
+
+def measure(pp, num_micro, *, remat="tick", layers_per_stage=2, b=2, s=512,
+            h=256, ffn=512, heads=8, vocab=512):
+    """Per-device temp bytes + per-device HLO FLOPs of the compiled
+    jit(grad(pipelined_loss)) for one pipeline_remat policy."""
+    cfg = _cfg(pp, layers_per_stage=layers_per_stage, b=b, s=s, h=h,
+               ffn=ffn, heads=heads, vocab=vocab)
     model = LlamaModel(cfg)
     ctx = initialize_parallel(dp=1, pp=pp, tp=8 // pp if pp < 8 else 1)
     try:
         pcfg = ParallelConfig(
             pipeline_parallel_size=pp, tensor_parallel_size=ctx.tp,
-            num_microbatches=num_micro,
+            num_microbatches=num_micro, pipeline_remat=remat,
         )
         params = model.init(jax.random.key(0))
         specs = pipeline_param_specs(cfg, params)
@@ -87,6 +94,7 @@ def measure(pp, num_micro, *, layers_per_stage=2, b=2, s=512, h=256,
         loss_fn = make_pipelined_loss_fn(model, pcfg, ctx)
         compiled = jax.jit(jax.grad(loss_fn)).lower(sharded, batch).compile()
         temp = compiled.memory_analysis().temp_size_in_bytes
+        flops = (compiled.cost_analysis() or {}).get("flops", float("nan"))
     finally:
         destroy_parallel()
 
@@ -103,7 +111,29 @@ def measure(pp, num_micro, *, layers_per_stage=2, b=2, s=512, h=256,
     per_layer_per_tok = (10 * h + 3 * ffn) * 2
     fifb_model = min(pp, num_micro) * layers_per_stage * b * s * \
         per_layer_per_tok
-    return temp, boundary_model, fifb_model
+    return temp, flops, boundary_model, fifb_model
+
+
+def measure_nonpipelined(pp, num_micro, *, layers_per_stage=2, b=2, s=512,
+                         h=256, ffn=512, heads=8, vocab=512):
+    """Single-device jit(grad(mean-over-microbatch loss)) of the SAME model
+    and global batch — the FLOP floor (no pipeline, no remat: AD saves
+    everything) that the pipelined variants are compared against."""
+    cfg = _cfg(pp, layers_per_stage=layers_per_stage, b=b, s=s, h=h,
+               ffn=ffn, heads=heads, vocab=vocab)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    tokens = jnp.zeros((num_micro, b, s), jnp.int32)
+
+    def loss(p):
+        losses = [model.loss(p, tokens[m], tokens[m])
+                  for m in range(num_micro)]
+        return sum(losses) / num_micro
+
+    compiled = jax.jit(jax.grad(loss)).lower(params).compile()
+    temp = compiled.memory_analysis().temp_size_in_bytes
+    flops = (compiled.cost_analysis() or {}).get("flops", float("nan"))
+    return temp, flops
 
 
 def main():
@@ -111,7 +141,7 @@ def main():
     rows = []
     for pp in (4, 8):
         for nm in (4, 8, 16):
-            temp, bnd, fifb = measure(pp, nm)
+            temp, flops, bnd, fifb = measure(pp, nm)
             rows.append((pp, nm, temp, bnd, fifb))
             print(f"pp={pp} num_micro={nm:2d}: measured temp "
                   f"{temp/2**20:7.1f} MB | boundary model "
@@ -125,6 +155,35 @@ def main():
     for pp, nm, temp, bnd, fifb in rows:
         print(f"| {pp} | {nm} | {temp/2**20:.1f} | {bnd/2**20:.1f} | "
               f"{fifb/2**20:.1f} |")
+
+    # ---- remat-policy FLOP/memory trade (VERDICT r4 #1) -----------------
+    # The static HLO count has two structural inflations shared EQUALLY by
+    # all three policies: (a) the in-tick head/embed are counted on every
+    # stage (at runtime the lax.cond head runs only on the last stage) and
+    # (b) the fill/drain bubble — every stage computes all
+    # (num_micro + pp - 1) ticks, so the schedule really executes
+    # ticks/num_micro x the ideal layer FLOPs (that is the GPipe bubble,
+    # shrunk by raising num_micro — the design's bubble lever). What the
+    # policies DIFFER in is exactly the rematerialization tax, so it is
+    # isolated as each policy's total over the cheapest policy's.
+    print("\nremat-policy trade (num_micro=8):\n")
+    print("| pp | policy | per-dev temp (MB) | total HLO GFLOPs | "
+          "remat tax vs cheapest policy |")
+    print("|---|---|---|---|---|")
+    for pp in (4, 8):
+        base_temp, base_flops = measure_nonpipelined(pp, 8)
+        rows = []
+        for remat in ("tick", "dots", "none"):
+            temp, flops, _, _ = measure(pp, 8, remat=remat)
+            rows.append((remat, temp, flops * 8))
+        floor = min(t for _, _, t in rows)
+        bubble = (8 + pp - 1) / 8
+        print(f"| {pp} | non-pipelined (1 dev) | {base_temp/2**20:.1f} | "
+              f"{base_flops/1e9:.2f} | — (schedule bubble at this "
+              f"num_micro: {bubble:.2f}x) |")
+        for remat, temp, total in rows:
+            print(f"| {pp} | {remat} | {temp/2**20:.1f} | "
+                  f"{total/1e9:.2f} | {total/floor-1.0:+.1%} |", flush=True)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,10 @@ def main():
     p.add_argument("--num_heads", type=int, default=8)
     p.add_argument("--num_kv_heads", type=int, default=4)
     p.add_argument("--vocab_size", type=int, default=512)
+    p.add_argument("--dump_layer_errors", action="store_true",
+                   help="per-layer hidden-state max-abs error vs HF on the "
+                        "first batch — localizes drift to the layer that "
+                        "introduces it (release-gate debugging aid)")
     args = p.parse_args()
 
     import torch
@@ -98,6 +102,39 @@ def main():
     params = jax.tree.map(jnp.asarray, convert(sd, cfg))
     model = (LlamaModel if args.model == "llama" else FalconModel)(cfg)
 
+    def dump_layer_errors(tokens):
+        """Per-layer hidden-state drift vs HF (embedding + each block),
+        running the native stack layer by layer."""
+        from megatron_llm_tpu.models.language_model import embed_tokens
+        from megatron_llm_tpu.models.rope import precompute_rope
+        from megatron_llm_tpu.models.transformer import transformer_layer
+
+        with torch.no_grad():
+            hf_states = hf(torch.tensor(tokens),
+                           output_hidden_states=True).hidden_states
+        rope = None
+        if cfg.position_embedding_type == "rotary":
+            rope = precompute_rope(cfg.head_dim, cfg.max_position_embeddings,
+                                   cfg.rope_theta, cfg.rope_scaling_factor)
+        from megatron_llm_tpu.models.norms import apply_norm
+
+        h = embed_tokens(params, cfg, jnp.asarray(tokens))
+        for i in range(cfg.num_layers + 1):
+            if i > 0:
+                layer_p = jax.tree.map(lambda x: x[i - 1], params["layers"])
+                h, _ = transformer_layer(layer_p, cfg, h, rope, None, None)
+            # transformers' LAST hidden state is post-final-norm
+            h_cmp = (apply_norm(h, params["final_norm"], cfg)
+                     if i == cfg.num_layers else h)
+            err = float(np.abs(
+                np.asarray(h_cmp, np.float32) - hf_states[i].numpy()
+            ).max())
+            name = "embedding" if i == 0 else f"layer {i - 1}"
+            if i == cfg.num_layers:
+                name += " (+final norm)"
+            print(f"  {name:>22s}: max abs hidden error {err:.3e}",
+                  flush=True)
+
     fwd = jax.jit(lambda p, t: model.forward(p, t)[0])
     rs = np.random.RandomState(0)
     max_errs, ok = [], True
@@ -123,6 +160,8 @@ def main():
         abs_err = np.abs(ours_logits - ref_logits)
         max_err, avg_err = float(abs_err.max()), float(abs_err.mean())
         max_errs.append(max_err)
+        if args.dump_layer_errors and it == 0:
+            dump_layer_errors(tokens)
         # ref verify_correctness.py prints this exact breakdown per iter
         print(
             f"iteration {it}: max abs logit error {max_err:.3e} | "
