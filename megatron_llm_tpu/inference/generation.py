@@ -127,11 +127,15 @@ def generate_tokens(
     if rng is None:
         rng = jax.random.key(0)  # unused on the greedy path
 
-    # one-time decode weight layout (GLU matvec bandwidth; see
-    # prepare_decode_params) — outside the token loop by construction
+    # one-time decode layout: per-layer standalone weights (no per-token
+    # stack slicing, flat GLU matvec) + per-layer (b, g, T, d) caches —
+    # see prepare_decode_params / init_kv_caches(layout="layers");
+    # outside the token loop by construction
     if hasattr(model, "prepare_decode_params"):
         params = model.prepare_decode_params(params)
-    caches = model.init_kv_caches(b, max_len)
+        caches = model.init_kv_caches(b, max_len, layout="layers")
+    else:
+        caches = model.init_kv_caches(b, max_len)
 
     log_probs = jnp.zeros((b, max_len - 1), jnp.float32)
 
@@ -248,8 +252,12 @@ def _beam_advance(model, params, toks, caches, beam_idx, token_idx, t):
     """Reorder beams, bank the chosen tokens, run one KV-cached step
     (ref: generation.py:359-398 beam reorder + forward)."""
     toks = jnp.take(toks, beam_idx, axis=0)
+    # cache batch axis: 0 in the per-layer (b, g, T, d) decode layout,
+    # 1 in the stacked (L, b, T, g, d) one
+    b_axis = 0 if "k_layers" in caches else 1
     caches = jax.tree.map(
-        lambda c: jnp.take(c, beam_idx, axis=1) if c.ndim >= 2 else c, caches
+        lambda c: jnp.take(c, beam_idx, axis=b_axis) if c.ndim >= 2 else c,
+        caches,
     )
     toks = jax.lax.dynamic_update_slice(
         toks, token_idx[:, None].astype(jnp.int32), (0, t)
@@ -332,7 +340,9 @@ def beam_search(
 
     if hasattr(model, "prepare_decode_params"):
         params = model.prepare_decode_params(params)
-    caches = model.init_kv_caches(beam_size, max_len)
+        caches = model.init_kv_caches(beam_size, max_len, layout="layers")
+    else:
+        caches = model.init_kv_caches(beam_size, max_len)
     logits, caches = model.forward(
         params, tokens[:, :prompt_length], kv_caches=caches
     )

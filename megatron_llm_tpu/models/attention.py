@@ -228,6 +228,44 @@ def attention_block(
         if rope_table is not None:
             q = apply_rope(q, rope_table, position_ids)
             k = apply_rope(k, rope_table, position_ids)
+        if "k_gtd" in kv_cache:
+            # decode fast path: per-layer standalone (b, g, T, d) caches
+            # (init_kv_caches layout="layers") — column updates and
+            # attention reads hit a small contiguous buffer in place, no
+            # per-layer stack slicing. (A (b, g, d, T) K layout was also
+            # measured: the minor-axis column scatter cost more than the
+            # sublane-reduce saved.)
+            g, qpk, d = cfg.num_query_groups, cfg.q_per_kv, cfg.head_dim
+            kc = jax.lax.dynamic_update_slice(
+                kv_cache["k_gtd"], k.transpose(0, 2, 1, 3), (0, 0, offset, 0)
+            )
+            vc = jax.lax.dynamic_update_slice(
+                kv_cache["v_gtd"], v.transpose(0, 2, 1, 3), (0, 0, offset, 0)
+            )
+            new_cache = {"k_gtd": kc, "v_gtd": vc, "offset": offset + s}
+            t = kc.shape[2]
+            qb = q.transpose(0, 2, 1, 3, 4).reshape(b, g, s * qpk, d)
+            scores = jax.lax.dot_general(
+                qb, kc, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32,
+            ) * (1.0 / jnp.sqrt(d).astype(jnp.float32))  # (b, g, s*qpk, t)
+            row_pos = offset + (
+                jnp.arange(s * qpk) // qpk
+            )  # row r is query position offset + r//qpk
+            dec_mask = jnp.arange(t)[None, :] > row_pos[:, None]
+            scores = jnp.where(dec_mask[None, None],
+                               jnp.finfo(jnp.float32).min, scores)
+            probs = jax.nn.softmax(scores, axis=-1).astype(vc.dtype)
+            out = jax.lax.dot_general(
+                probs, vc, (((3,), (2,)), ((0, 1), (0, 1))),
+            )  # (b, g, s*qpk, d)
+            ctx = out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4)
+            ctx = shard_activation(ctx.reshape(b, s, g, qpk * d), "heads") \
+                .reshape(b, s, -1)
+            out = ctx @ attn_params["wo"].astype(compute_dtype)
+            if "bo" in attn_params:
+                out = out + attn_params["bo"].astype(compute_dtype)
+            return out, new_cache
         if "layer" in kv_cache:
             # stacked-cache form (decode hot path): update THIS layer's
             # token column in place inside the full (L, b, T, g, d) stack

@@ -76,27 +76,64 @@ class GPTModel:
         return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
 
     def prepare_decode_params(self, params: dict) -> dict:
-        """Decode-layout view of the params: the stacked GLU up/gate
-        weight (L, h, 2, f) flattened to (L, h, 2f) — a row-major bitcast
-        done ONCE before the decode loop, so every single-token MLP matvec
-        streams the weight at full GEMV bandwidth instead of tiling the
-        2-sized gate/up axis into sublanes (~33% of HBM bandwidth, traced
-        on v5e; mlp_block dispatches on the weight's rank)."""
-        if not self.cfg.glu_activation:
-            return params
+        """Decode-layout view of the params, built ONCE before the token
+        loop (called inside generate's jit, ahead of the while_loop):
+
+        - the stacked (L, ...) layer tree is split into a TUPLE of
+          per-layer trees of standalone contiguous arrays. Inside the
+          decode loop the layer scan would otherwise dynamic-slice every
+          layer's weights into fresh buffers each token — a full extra
+          read+write of all layer weights per step (traced on v5e:
+          ~95us/layer/step, i.e. the GEMVs paid double their weight
+          traffic). transformer_stack unrolls over the tuple;
+        - the GLU up/gate weight (h, 2, f) is flattened to (h, 2f) (a
+          row-major bitcast): the 2-sized axis otherwise tiles into
+          sublanes and the matvec streams at ~33% of HBM bandwidth.
+        """
+        import jax
+
+        L = self.cfg.num_layers
+        stacked = params["layers"]
+
+        def layer_slice(i):
+            layer = jax.tree.map(lambda x: x[i], stacked)
+            if self.cfg.glu_activation:
+                mlp = dict(layer["mlp"])
+                w1 = mlp["w1"]
+                mlp["w1"] = w1.reshape(w1.shape[0], -1)
+                layer = dict(layer)
+                layer["mlp"] = mlp
+            return layer
+
         params = dict(params)
-        layers = dict(params["layers"])
-        mlp = dict(layers["mlp"])
-        w1 = mlp["w1"]
-        mlp["w1"] = w1.reshape(w1.shape[0], w1.shape[1], -1)
-        layers["mlp"] = mlp
-        params["layers"] = layers
+        params["layers"] = tuple(layer_slice(i) for i in range(L))
         return params
 
-    def init_kv_caches(self, batch_size: int, max_len: int) -> dict:
-        """Per-layer stacked KV cache for incremental decode
-        (ref: InferenceParams forward_step.py:17-41)."""
+    def init_kv_caches(self, batch_size: int, max_len: int,
+                       layout: str = "stacked") -> dict:
+        """KV cache for incremental decode (ref: InferenceParams
+        forward_step.py:17-41).
+
+        layout="stacked": one (L, b, T, g, d) pair — what the layer scan
+        (and the pp pipelined decode's per-stage shards) carries.
+        layout="layers": per-layer standalone (b, g, T, d) arrays for the
+        unrolled decode path (see prepare_decode_params) — each layer's
+        column update and attention read hit a small buffer in place with
+        no per-layer stack slicing, and the (g, T) order makes the
+        QK/PV contractions clean (b*g)-batched GEMMs over the T axis.
+        """
         cfg = self.cfg
+        if layout == "layers":
+            shape = (batch_size, cfg.num_query_groups, max_len,
+                     cfg.head_dim)
+            return {
+                "k_layers": tuple(jnp.zeros(shape, cfg.compute_dtype)
+                                  for _ in range(cfg.num_layers)),
+                "v_layers": tuple(jnp.zeros(shape, cfg.compute_dtype)
+                                  for _ in range(cfg.num_layers)),
+                "offset": jnp.array(0, jnp.int32),
+            }
+        assert layout == "stacked", layout
         shape = (cfg.num_layers, batch_size, max_len, cfg.num_query_groups,
                  cfg.head_dim)
         return {

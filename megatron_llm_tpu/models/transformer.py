@@ -232,7 +232,9 @@ def transformer_stack(
     transformer.py:1015-1045): layer i's dropout key and LIMA rate use
     global index layer_offset + i.
     """
-    L = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+    unrolled = isinstance(layer_params, (list, tuple))
+    L = len(layer_params) if unrolled \
+        else jax.tree_util.tree_leaves(layer_params)[0].shape[0]
     num_total = cfg.num_layers
 
     def body(carry, xs):
@@ -267,6 +269,27 @@ def transformer_stack(
     body_ck = jax.checkpoint(body, prevent_cse=False)
 
     idxs = layer_offset + jnp.arange(L)
+    if unrolled:
+        # Decode fast path (prepare_decode_params): per-layer standalone
+        # weight trees + per-layer (b, g, T, d) caches, layer loop
+        # UNROLLED in Python. The scan form dynamic-slices every layer's
+        # weights AND cache out of stacked buffers each token — a full
+        # extra read+write of the weights and cache per step (traced on
+        # v5e); standalone buffers are read in place.
+        assert kv_caches is not None and "k_layers" in kv_caches, \
+            "unrolled (tuple) layer params are the decode fast path"
+        offset = kv_caches["offset"]
+        ks = list(kv_caches["k_layers"])
+        vs = list(kv_caches["v_layers"])
+        for i in range(L):
+            cache_l = {"k_gtd": ks[i], "v_gtd": vs[i], "offset": offset}
+            (hidden,), nc = body(
+                (hidden,), (layer_params[i], idxs[i], cache_l)
+            )
+            ks[i], vs[i] = nc["k_gtd"], nc["v_gtd"]
+        new_caches = {"k_layers": tuple(ks), "v_layers": tuple(vs),
+                      "offset": offset + hidden.shape[1]}
+        return hidden, new_caches
     if kv_caches is not None:
         # Decode: the FULL (L, b, T, g, d) cache stacks ride the scan
         # CARRY and each layer updates its token column in place

@@ -794,8 +794,18 @@ def make_pipelined_decode_fn(model, pcfg, ctx: ParallelContext, *,
             offsets0 = pv(jnp.full((nm,), prefill_len, jnp.int32))
             state0 = pv(jnp.zeros((b_m, 1, cfg.hidden_size),
                                   boundary_dtype))
-            done0 = pv(jnp.zeros((nm, b_m), bool))
-            glens0 = pv(jnp.full((nm, b_m), max_len, jnp.int32))
+            # the SEED token (sampled at position prefill_len during
+            # prefill) gets the same eod bookkeeping generate_tokens
+            # applies to every generated position; seeds are only real on
+            # the last stage — the same authority the updates below keep
+            if termination_id is not None:
+                seed_done = (seeds == termination_id) & \
+                    (lens <= prefill_len)
+                done0 = seed_done
+                glens0 = jnp.where(seed_done, prefill_len + 1, max_len)
+            else:
+                done0 = pv(jnp.zeros((nm, b_m), bool))
+                glens0 = pv(jnp.full((nm, b_m), max_len, jnp.int32))
             total = steps * nm + pp - 1
 
             def cond(carry):

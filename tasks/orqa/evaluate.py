@@ -111,36 +111,22 @@ class ORQAEvaluator:
 
     def retrieve(self, questions: List[str], topk: int = 20,
                  chunk_rows: int = 1 << 20):
-        """MIPS: (Q, d) @ (d, N) + top-k (the FAISS replacement),
-        CHUNKED over the evidence axis with a running top-k merge so the
-        (Q, N) score matrix never materializes and each device transfer
-        is one <=chunk_rows slice of the host-resident evidence matrix."""
+        """MIPS: (Q, d) @ (d, N) + top-k (the FAISS replacement) via the
+        shared chunked-search implementation
+        (megatron_llm_tpu.data.realm_index.MIPSIndex — the score matrix
+        never materializes; evidence streams through the device one
+        <=chunk_rows slice at a time). Index rows are evidence-list
+        POSITIONS, mapped back to evidence ids on return."""
+        from megatron_llm_tpu.data.realm_index import MIPSIndex
+
         assert self.evidence_emb is not None, "call build_index first"
-        q = jnp.asarray(self._embed_texts(questions, "query"))
-        n = self.evidence_emb.shape[0]
-        k = min(topk, n)
-
-        @jax.jit
-        def chunk_topk(q, ev):
-            s = q @ ev.T
-            kk = min(k, s.shape[-1])
-            return jax.lax.top_k(s, kk)
-
-        best_scores = np.full((len(questions), 0), -np.inf, np.float32)
-        best_idx = np.zeros((len(questions), 0), np.int64)
-        for lo in range(0, n, chunk_rows):
-            ev = jnp.asarray(self.evidence_emb[lo:lo + chunk_rows])
-            s, i = chunk_topk(q, ev)
-            best_scores = np.concatenate(
-                [best_scores, np.asarray(s)], axis=1)
-            best_idx = np.concatenate(
-                [best_idx, np.asarray(i, np.int64) + lo], axis=1)
-            order = np.argsort(-best_scores, axis=1)[:, :k]
-            best_scores = np.take_along_axis(best_scores, order, axis=1)
-            best_idx = np.take_along_axis(best_idx, order, axis=1)
+        index = MIPSIndex(self.evidence_emb.shape[1],
+                          {i: e for i, e in enumerate(self.evidence_emb)},
+                          chunk_rows=chunk_rows)
+        q = self._embed_texts(questions, "query")
+        scores, pos = index.search_mips_index(q, topk)
         return [
-            ([self.evidence_ids[j] for j in best_idx[i]],
-             list(best_scores[i]))
+            ([self.evidence_ids[j] for j in pos[i]], list(scores[i]))
             for i in range(len(questions))
         ]
 
