@@ -88,6 +88,15 @@ class ModelConfig:
     # Kernels
     use_flash_attn: bool = False  # Pallas flash-attention path
     use_fused_rmsnorm: bool = False  # Pallas fused RMSNorm path
+    # Pallas decode-attention kernel (ops/decode_attention.py) on the
+    # KV-cached single-token path. Default ON: off-TPU it falls back to
+    # the XLA decode math unless decode_attn_interpret routes the real
+    # kernel through the Pallas interpreter (the CPU test path).
+    use_decode_attn: bool = True
+    # below this allocated cache length the XLA matvecs win (kernel
+    # launch overhead dominates a cache this small)
+    decode_attn_min_cache: int = 128
+    decode_attn_interpret: bool = False
 
     # BERT/T5 family (ref: --num_tokentypes language_model.py:160-170;
     # bert_binary_head bert_model.py:130)

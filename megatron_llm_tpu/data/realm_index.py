@@ -17,11 +17,36 @@ departures:
 
 from __future__ import annotations
 
+import functools
 import glob
 import os
 from typing import Dict, Optional
 
 import numpy as np
+
+
+@functools.lru_cache(maxsize=1)
+def _chunk_topk():
+    """Module-scope jitted chunk scorer (ADVICE r5: defining+jitting it
+    inside search_mips_index re-traced on every call). jit's own cache
+    keys on the (Q, d) x (chunk, d) shapes and static k, and the final
+    partial chunk is PADDED to chunk_rows by the caller, so one
+    executable serves every chunk of every same-shaped search. Pad rows
+    are masked to -inf BEFORE top_k (`n_valid` is traced, so it doesn't
+    split the executable): a pad row's raw score of 0.0 would otherwise
+    displace real negative-score rows inside the chunk's top-k. Lazy via
+    lru_cache so importing the data package doesn't pull in jax."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def chunk_topk(q, ev, n_valid, k):
+        s = q @ ev.T
+        s = jnp.where(jnp.arange(s.shape[-1])[None, :] < n_valid,
+                      s, -jnp.inf)
+        return jax.lax.top_k(s, k)
+
+    return chunk_topk
 
 
 class OpenRetrievalDataStore:
@@ -155,27 +180,36 @@ class MIPSIndex:
                           reconstruct: bool = False):
         """(Q, d) queries -> (scores (Q, k), ids (Q, k)) — or (scores,
         embeddings (Q, k, d)) when reconstruct (ref :205-216). Chunked
-        over the evidence axis with a running top-k merge."""
-        import jax
+        over the evidence axis with a running top-k merge; every chunk
+        (including the final partial one, zero-padded to chunk_rows) hits
+        the ONE module-scope jitted executable — no per-call re-tracing
+        and no second partial-chunk executable (ADVICE r5)."""
         import jax.numpy as jnp
 
         q = jnp.asarray(np.asarray(query_embeds, np.float32))
         n = self.embeds.shape[0]
         k = min(top_k, n)
-
-        @jax.jit
-        def chunk_topk(q, ev):
-            s = q @ ev.T
-            return jax.lax.top_k(s, min(k, s.shape[-1]))
+        chunk_topk = _chunk_topk()
+        kk = min(k, self.chunk_rows)
 
         best_s = np.full((q.shape[0], 0), -np.inf, np.float32)
         best_i = np.zeros((q.shape[0], 0), np.int64)
         for lo in range(0, n, self.chunk_rows):
-            ev = jnp.asarray(self.embeds[lo:lo + self.chunk_rows])
-            s, i = chunk_topk(q, ev)
-            best_s = np.concatenate([best_s, np.asarray(s)], axis=1)
-            best_i = np.concatenate(
-                [best_i, np.asarray(i, np.int64) + lo], axis=1)
+            ev = self.embeds[lo:lo + self.chunk_rows]
+            n_valid = ev.shape[0]
+            if n_valid < self.chunk_rows:  # pad the final partial chunk
+                ev = np.concatenate([
+                    ev,
+                    np.zeros((self.chunk_rows - n_valid, ev.shape[1]),
+                             np.float32),
+                ])
+            s, i = chunk_topk(q, jnp.asarray(ev), n_valid, kk)
+            s = np.asarray(s)
+            i = np.asarray(i, np.int64) + lo
+            # pad rows arrive already -inf-masked; clamp their ids so the
+            # final take stays in range even if one survives the merge
+            best_s = np.concatenate([best_s, s], axis=1)
+            best_i = np.concatenate([best_i, np.minimum(i, n - 1)], axis=1)
             order = np.argsort(-best_s, axis=1)[:, :k]
             best_s = np.take_along_axis(best_s, order, axis=1)
             best_i = np.take_along_axis(best_i, order, axis=1)

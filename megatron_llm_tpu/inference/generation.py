@@ -14,6 +14,15 @@ still-in-prompt rows, logprob gathering and eod early-termination all live
 inside the loop, so there is no per-token host round-trip. The pipeline
 broadcast machinery (ref text_generation/communication.py) has no
 analogue: under GSPMD the logits land wherever the sampling runs.
+
+Per-step attention inside the loop body runs the Pallas decode-attention
+kernel by default on TPU (ops/decode_attention.py, routed by
+models/attention.py's cached branches): the per-layer (b, g, T, d)
+caches stream through VMEM at HBM line rate with in-kernel cache-length
+masking, instead of XLA's under-bandwidth matvec loops. The XLA path
+remains the fallback below `cfg.decode_attn_min_cache` and off-TPU;
+tokens and logprobs are exact-match between the two
+(tests/test_decode_attention.py).
 """
 
 from __future__ import annotations

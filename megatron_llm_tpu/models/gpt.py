@@ -121,6 +121,11 @@ class GPTModel:
         column update and attention read hit a small buffer in place with
         no per-layer stack slicing, and the (g, T) order makes the
         QK/PV contractions clean (b*g)-batched GEMMs over the T axis.
+
+        Both layouts feed the Pallas decode-attention kernel in place
+        (ops/decode_attention.py: "gtd" = layers, "tgd" = a stacked
+        layer's slice); a max_len with a power-of-2 factor >= 16 keeps
+        the kernel eligible (otherwise the XLA matvecs serve the cache).
         """
         cfg = self.cfg
         if layout == "layers":

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels: flash attention (training), decode attention
+(KV-cached serving), fused RMSNorm. Each module dispatches to a
+numerically matching XLA path off-TPU; `interpret=True` runs the real
+kernels through the Pallas interpreter (the CPU test suites)."""
+
+from megatron_llm_tpu.ops.decode_attention import (  # noqa: F401
+    decode_attention,
+    decode_attn_block,
+)
+from megatron_llm_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_with_lse,
+)

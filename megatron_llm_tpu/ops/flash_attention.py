@@ -54,6 +54,14 @@ MAX_ROWS = 2048
 MAX_CELLS = 1 << 20
 
 
+def _compiler_params(**kw):
+    """pltpu.CompilerParams under current JAX; TPUCompilerParams on the
+    0.4.x line — both accept dimension_semantics."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def _xla_reference(q, k, v, causal: bool):
     """Un-tiled reference path; same math, XLA-fused softmax."""
     b, s, g, qpk, d = q.shape
@@ -88,14 +96,21 @@ def _xla_reference_with_lse(q, k, v, causal: bool):
     return o, jnp.moveaxis(lse, 3, 1)  # lse -> (b, s, g, qpk)
 
 
-def _out_struct(shape, dtype, like):
-    """ShapeDtypeStruct carrying the operand's varying-manual-axes set:
-    inside a shard_map manual region (ring attention's per-hop call) the
-    kernel outputs must declare how they vary across the manual axes or
-    tracing rejects them (check_vma)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+def _out_struct(shape, dtype, *likes):
+    """ShapeDtypeStruct carrying the union of the operands' varying-
+    manual-axes sets: inside a shard_map manual region (ring attention's
+    per-hop call, the pipelined decode's stage region) the kernel
+    outputs must declare how they vary across the manual axes or tracing
+    rejects them (check_vma). On JAX builds without jax.typeof there are
+    no manual regions to satisfy."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    vma = set()
+    for x in likes:
+        vma |= set(getattr(typeof(x), "vma", None) or ())
     if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
@@ -270,7 +285,7 @@ def _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret=False):
         ],
         # (bg, q) grid steps are independent; only the k dim carries the
         # online-softmax accumulator state
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else _compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -472,7 +487,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
         out_specs=pl.BlockSpec((1, block_q, qpk * d), lambda h, i, j: (h, i, 0)),
         out_shape=_out_struct((b * g, s, qpk * d), q.dtype, qf),
         scratch_shapes=[pltpu.VMEM((block_q * qpk, d), jnp.float32)],
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else _compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -506,7 +521,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else _compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

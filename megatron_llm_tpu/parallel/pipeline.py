@@ -576,6 +576,14 @@ def make_pipelined_decode_fn(model, pcfg, ctx: ParallelContext, *,
     region past max_len (offset redirect) so no per-tick buffer select is
     needed.
 
+    Decode ticks (s == 1) stream each layer's stacked-cache slice through
+    the Pallas decode-attention kernel ("tgd" layout, in place — no
+    transpose) whenever the scratch-tailed cache length is kernel-
+    eligible (models/attention.py routes there; exact-match vs the
+    single-mesh engine in tests/test_pp_inference.py), so pp-mesh serving
+    gets the same HBM-line-rate attention as the unrolled decode path.
+    Prefill chunks (s > 1) keep the batched-GEMM path.
+
     Returns decode(params, tokens (b, max_len), lengths (b,), rng) ->
     (tokens, gen_lengths, log_probs|None), semantics matching
     `generation.generate_tokens` (greedy path exact).

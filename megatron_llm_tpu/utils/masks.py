@@ -35,10 +35,21 @@ def get_ltor_masks_and_position_ids(
 ) -> Tuple[Optional[jnp.ndarray], jnp.ndarray, jnp.ndarray]:
     """Returns (attention_mask, loss_mask, position_ids).
 
-    attention_mask is (b, 1, s, s) boolean, True = masked out, or None when
-    plain causal (so the flash path can be taken). EOD-reset variants are
-    built vectorised (the reference loops over batch in Python,
-    ref: utils.py:162-191); document boundaries are where tokens == eod.
+    attention_mask is (b, 1, s, s) boolean, True = masked out — or
+    **None** whenever the mask is plain causal, i.e. when
+    `reset_attention_mask=False` (with or without `reset_position_ids`).
+    `None` means "causal" to every attention consumer in this repo and
+    keeps the flash / decode kernel paths eligible; callers that index
+    the returned mask must handle it. NOTE this is an exported-API
+    departure from the reference, which always materializes the dense
+    (b, 1, s, s) tensor (ref: utils.py:137-196) — external callers
+    porting reference scripts should pass the None straight through to
+    `attention_mask=` or rebuild a dense mask with
+    `models.attention.causal_mask(s)` (see docs/GUIDE.md, "Masks").
+
+    EOD-reset variants are built vectorised (the reference loops over
+    batch in Python, ref: utils.py:162-191); document boundaries are
+    where tokens == eod.
     """
     b, s = tokens.shape
     rows = jnp.arange(s)[:, None]

@@ -360,6 +360,34 @@ class TestRetrievalIndex:
             scores, np.take_along_axis(ref, ref_order, axis=1), rtol=1e-5
         )
 
+    def test_search_single_executable_and_padded_tail(self):
+        """ADVICE r5: the chunk scorer is jitted ONCE at module scope and
+        the final partial chunk is padded to chunk_rows — repeated
+        searches (partial tail included) share one executable, and pad
+        rows (score 0) never outrank real negative scores."""
+        from megatron_llm_tpu.data.realm_index import MIPSIndex, _chunk_topk
+
+        # ALL-negative inner products with the global best in the padded
+        # tail chunk: a pad row's raw score (0.0) would displace it
+        # inside the chunk top_k unless pads are -inf-masked BEFORE the
+        # top_k (not just knocked out of the merge afterwards)
+        q = -np.ones((3, 8), np.float32)
+        mags = np.asarray([9.0, 8.0, 7.0, 6.0, 0.5], np.float32)
+        ev = np.ones((5, 8), np.float32) * mags[:, None]  # 5 % 4 != 0
+        index = MIPSIndex(8, dict(enumerate(ev)), chunk_rows=4)
+        fn = _chunk_topk()
+        before = fn._cache_size()
+        for _ in range(3):
+            scores, ids = index.search_mips_index(q, top_k=2)
+        assert fn._cache_size() - before <= 1, "chunk scorer re-traced"
+        ref = q @ ev.T
+        order = np.argsort(-ref, axis=1)[:, :2]
+        assert order[0, 0] == 4  # the tail-chunk row IS the global best
+        np.testing.assert_array_equal(ids, order)
+        np.testing.assert_allclose(
+            scores, np.take_along_axis(ref, order, axis=1), rtol=1e-5
+        )
+
     def test_build_index_cli_and_prebuilt_eval_parity(self, tmp_path):
         """tools/build_retrieval_index.py writes a store the evaluator
         loads; retrieval results equal the on-the-fly path exactly."""
