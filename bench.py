@@ -14,6 +14,13 @@ bandwidth (`decode_attn_gbps_b8`, fraction of the 819 GB/s v5e peak),
 and the flash kernel reports fwd/bwd MXU utilization (`flash_fwd_mxu`,
 `flash_bwd_mxu`) — so the roofline claims are auditable round-over-round.
 
+Round-7 audit keys: the remat-policy ladder (models/remat.py;
+full/offload/selective/save_dots/none) is swept at a shared (seq, mbs)
+point — per-policy tok/s, MFU, and compiled peak-HBM
+(`memory_analysis()` temp/args bytes) land in `extra.remat_sweep`, with
+`remat_selective_vs_full_tok_s` as the headline FLOP-tax audit ratio, and
+the headline row states which policy it trained under.
+
 Methodology: the reference's in-repo anchor is the Llama-2-7B fine-tune at
 ~890 tokens/sec/GPU on A100-80GB (BASELINE.md; docs/guide/getting_started.md
 :195-201). A 7B model does not fit on the single 16GB v5e chip available
@@ -47,7 +54,7 @@ V5E_PEAK_BF16 = 197e12  # per-chip bf16 FLOP/s
 V5E_HBM_BYTES_S = 819e9  # per-chip HBM bandwidth
 
 
-def make_cfg(seq):
+def make_cfg(seq, remat_policy="full"):
     return ModelConfig(
         num_layers=12,
         hidden_size=2048,
@@ -66,19 +73,23 @@ def make_cfg(seq):
         attention_dropout=0.0,
         params_dtype=jnp.float32,  # fp32 master params, bf16 compute
         use_flash_attn=True,
-        recompute_granularity="full",
+        remat_policy=remat_policy,
     )
 
 
-def run_train(seq, iters):
-    """One-chip train-step throughput at `seq`. Returns (tok/s, MFU, 6N)."""
-    # Full remat is memory-forced at 0.74B on the 16GB chip (live
-    # activations need 23G at mbs 8 / seq 1024 without it, measured r1);
-    # mbs swept on-chip r4: 12 peaks at seq 1024 (8/10/14/16/24 all
-    # lower), 6 peaks at seq 4096 (7/8 lower, 10+ OOMs the compiler),
-    # 3 at seq 8192.
-    mbs = {1024: 12, 4096: 6, 8192: 3}[seq]
-    cfg = make_cfg(seq)
+def run_train(seq, iters, mbs=None, remat_policy="full", with_memory=False):
+    """One-chip train-step throughput at `seq` under `remat_policy`
+    (models/remat.py ladder). Returns (tok/s, MFU, n_params[, memdict]):
+    `with_memory=True` adds the AOT `compiled.memory_analysis()` per-device
+    peak temp / args bytes of the exact step that was timed."""
+    # Full remat is memory-forced at 0.74B on the 16GB chip at the PEAK
+    # mbs (live activations need 23G at mbs 8 / seq 1024 without it,
+    # measured r1); mbs swept on-chip r4: 12 peaks at seq 1024 (8/10/14/
+    # 16/24 all lower), 6 peaks at seq 4096 (7/8 lower, 10+ OOMs the
+    # compiler), 3 at seq 8192. The remat-policy sweep passes a smaller
+    # shared mbs so every rung of the ladder fits.
+    mbs = mbs if mbs is not None else {1024: 12, 4096: 6, 8192: 3}[seq]
+    cfg = make_cfg(seq, remat_policy=remat_policy)
     model = LlamaModel(cfg)
     params = model.init(jax.random.key(0))
     n_params = sum(p.size for p in jax.tree.leaves(params))
@@ -92,6 +103,19 @@ def run_train(seq, iters):
     batch = {"tokens": tokens, "labels": jnp.roll(tokens, -1, axis=-1)}
     lr = jnp.float32(1e-4)
     wd = jnp.float32(0.0)
+
+    mem = None
+    if with_memory:
+        # AOT peak-HBM audit of the exact step about to be timed. The
+        # timed calls below go through the SAME compiled executable — on
+        # this JAX line .lower().compile() does NOT populate the jit call
+        # cache, so calling the jit again would pay a second full compile.
+        step = step.lower(params, opt_state, batch, lr, wd).compile()
+        m = step.memory_analysis()
+        mem = {
+            "temp_bytes": int(m.temp_size_in_bytes),
+            "args_bytes": int(m.argument_size_in_bytes),
+        }
 
     # warmup (compile). NOTE: on the axon platform block_until_ready is a
     # no-op; a host fetch (float()) is the only real synchronization.
@@ -117,7 +141,39 @@ def run_train(seq, iters):
     attn_flops_per_tok = 6 * cfg.num_layers * cfg.hidden_size * seq
     flops_per_tok = 6 * n_params + attn_flops_per_tok
     mfu = tok_per_sec * flops_per_tok / V5E_PEAK_BF16
+    if with_memory:
+        return tok_per_sec, mfu, n_params, mem
     return tok_per_sec, mfu, n_params
+
+
+# every policy the sweep audits, cheapest-HBM first; see models/remat.py
+REMAT_SWEEP_POLICIES = ("full", "offload", "selective", "save_dots", "none")
+REMAT_SWEEP_MBS = 2  # shared mbs small enough that even "none" fits 16GB
+
+
+def remat_policy_sweep(seq=1024, iters=10):
+    """tok/s + MFU + compiled peak-HBM per remat policy at a SHARED
+    (seq, mbs) point, so the ladder's FLOP/memory trade is auditable
+    round-over-round. A policy that fails (OOM, unsupported offload on
+    this platform) records its error instead of killing the artifact
+    run."""
+    rows = []
+    for pol in REMAT_SWEEP_POLICIES:
+        try:
+            tok, mfu, _, mem = run_train(
+                seq, iters, mbs=REMAT_SWEEP_MBS, remat_policy=pol,
+                with_memory=True,
+            )
+            rows.append({
+                "policy": pol,
+                "tok_s": round(tok, 1),
+                "mfu": round(mfu, 4),
+                "temp_gb": round(mem["temp_bytes"] / 2**30, 3),
+                "args_gb": round(mem["args_bytes"] / 2**30, 3),
+            })
+        except Exception as e:  # noqa: BLE001 — audit row, not a gate
+            rows.append({"policy": pol, "error": str(e)[:200]})
+    return rows
 
 
 def run_decode(b, gen=512, prompt=64, use_decode_attn=True):
@@ -365,7 +421,8 @@ def main():
         print(json.dumps({
             "metric": (f"tokens/sec/chip, Llama-arch 0.74B pretrain, "
                        f"seq {args.seq}, bf16, flash-attn(Pallas) ON, "
-                       f"full remat, v5e, MFU {mfu:.1%}"),
+                       f"remat_policy=full (memory-forced at peak mbs), "
+                       f"v5e, MFU {mfu:.1%}"),
             "value": round(tok, 1),
             "unit": "tokens/sec/chip",
             "vs_baseline": round(tok * 6 * n_params / (890.0 * 6 * 7.0e9), 3),
@@ -375,6 +432,12 @@ def main():
     tok1, mfu1, n_params = run_train(1024, args.iters)
     tok4, mfu4, _ = run_train(4096, args.iters)
     tok8, mfu8, _ = run_train(8192, max(args.iters // 2, 5))
+    # remat-policy ladder audit (models/remat.py) at a shared sweep shape
+    remat_rows = remat_policy_sweep(seq=1024, iters=max(args.iters // 2, 5))
+    by_pol = {r["policy"]: r for r in remat_rows}
+    sel, ful = by_pol.get("selective", {}), by_pol.get("full", {})
+    sel_vs_full = (round(sel["tok_s"] / ful["tok_s"], 3)
+                   if sel.get("tok_s") and ful.get("tok_s") else None)
     ratio = flash_vs_xla_ratio()
     gen = 512
     dec1 = run_decode(1, gen=gen)
@@ -390,11 +453,14 @@ def main():
     print(json.dumps({
         "metric": (
             f"tokens/sec/chip, Llama-arch 0.74B pretrain, seq 1024, bf16, "
-            f"flash-attn(Pallas) ON, full remat, v5e, MFU {mfu1:.1%} "
+            f"flash-attn(Pallas) ON, remat_policy=full (memory-forced at "
+            f"peak mbs), v5e, MFU {mfu1:.1%} "
             f"(FLOP-normalized vs A100 7B anchor); "
             f"seq 4096: {tok4:.0f} tok/s, MFU {mfu4:.1%}; "
             f"seq 8192: {tok8:.0f} tok/s, MFU {mfu8:.1%}; "
-            f"flash-vs-XLA fwd+bwd speedup {ratio:.2f}x, "
+            + (f"remat sweep @mbs{REMAT_SWEEP_MBS}: selective/full tok/s "
+               f"{sel_vs_full}x; " if sel_vs_full else "")
+            + f"flash-vs-XLA fwd+bwd speedup {ratio:.2f}x, "
             f"fwd MXU {mxu['flash_fwd_mxu']:.1%}; "
             f"greedy decode {dec1:.0f} tok/s @b1, {dec8:.0f} @b8 "
             f"(decode-attn kernel ON; XLA-attn: {dec1_xla:.0f} @b1, "
@@ -406,6 +472,10 @@ def main():
         "unit": "tokens/sec/chip",
         "vs_baseline": round(achieved / baseline, 3),
         "extra": {
+            "remat_policy": "full",
+            "remat_sweep_mbs": REMAT_SWEEP_MBS,
+            "remat_sweep": remat_rows,
+            "remat_selective_vs_full_tok_s": sel_vs_full,
             "mfu_seq1024": round(mfu1, 4),
             "tok_s_seq4096": round(tok4, 1),
             "mfu_seq4096": round(mfu4, 4),

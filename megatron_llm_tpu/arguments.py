@@ -136,13 +136,6 @@ DESCOPED_FLAGS = {
         "provides the post-LN architecture (models/transformer.py)",
     "--init_method_xavier_uniform":
         "normal(--init_method_std) initialization only",
-    "--recompute_method":
-        "use --recompute_granularity full|selective; block-granular "
-        "remat crashes the TPU AOT compiler at scale "
-        "(docs/ROUND4_NOTES.md)",
-    "--recompute_num_layers":
-        "use --recompute_granularity full|selective (see "
-        "--recompute_method)",
     "--encoder_num_layers":
         "asymmetric encoder/decoder depth is unsupported; --num_layers "
         "sets both T5 stacks",
@@ -305,6 +298,19 @@ def build_base_parser() -> argparse.ArgumentParser:
     # ref: --recompute_activations is shorthand for selective granularity
     # (arguments.py:649-652)
     g.add_argument("--recompute_activations", action="store_true")
+    # first-class remat-policy name (ModelConfig.remat_policy /
+    # models/remat.py): the named-savepoint ladder. Give this OR the
+    # --recompute_* reference spellings; inconsistent combinations raise
+    # at config validation (ModelConfig.__post_init__), never train wrong.
+    g.add_argument("--remat_policy", default=None,
+                   choices=[None, "full", "selective", "save_dots",
+                            "offload", "none"])
+    # ref: --recompute_method/--recompute_num_layers (arguments.py:616-630)
+    # — "block" remats only the first N scanned layers (the split-scan
+    # path in models/transformer.py), composing with any remat policy
+    g.add_argument("--recompute_method", default=None,
+                   choices=[None, "uniform", "block"])
+    g.add_argument("--recompute_num_layers", type=int, default=None)
     g.add_argument("--sequence_parallel", action="store_true")
 
     g = p.add_argument_group("learning rate")  # ref :710-747
@@ -351,10 +357,13 @@ def build_base_parser() -> argparse.ArgumentParser:
     # context parallelism (ring attention over the sequence axis) — a
     # beyond-reference long-context axis; see ParallelConfig.
     g.add_argument("--context_parallel_size", type=int, default=1)
-    # pipeline backward remat policy (see ParallelConfig.pipeline_remat);
-    # "none"/"dots" give 1F1B-class FLOPs when per-stage HBM allows
+    # pipeline backward remat policy (see ParallelConfig.pipeline_remat) —
+    # the shared models/remat.py vocabulary plus the legacy tick/dots
+    # aliases; "none"/"dots"/"selective" give 1F1B-class FLOPs when
+    # per-stage HBM allows
     g.add_argument("--pipeline_remat", default="tick",
-                   choices=["tick", "dots", "none"])
+                   choices=["tick", "full", "selective", "dots",
+                            "save_dots", "offload", "none"])
 
     g = p.add_argument_group("validation")  # ref :870-877
     g.add_argument("--eval_iters", type=int, default=100)
@@ -465,8 +474,9 @@ def args_to_configs(args, padded_vocab_size: int):
         "init_method_std",
         "glu_activation", "position_embedding_type", "rope_scaling_factor",
         "rope_theta", "hidden_dropout", "attention_dropout", "lima_dropout",
-        "use_flash_attn", "recompute_granularity", "use_bias", "use_rms_norm",
-        "use_post_ln", "parallel_attn", "parallel_layernorm",
+        "use_flash_attn", "recompute_granularity", "remat_policy",
+        "recompute_method", "recompute_num_layers", "use_bias",
+        "use_rms_norm", "use_post_ln", "parallel_attn", "parallel_layernorm",
     ):
         v = getattr(args, name)
         if v is not None:

@@ -17,6 +17,33 @@ from typing import Any, Optional
 import jax.numpy as jnp
 
 # ---------------------------------------------------------------------------
+# Activation-recompute policy vocabulary (the registry's NAMES; the jax
+# policy objects live in models/remat.py so this module stays import-light).
+#
+# The ladder, cheapest-memory first (FLOPs move the other way):
+#   "full"      — jax.checkpoint with no policy: save only the layer
+#                 boundary carry, recompute everything (+~1/3 FLOPs).
+#   "offload"   — save the named matmul outputs like "selective" but park
+#                 them in pinned HOST memory (save_and_offload_only_these_
+#                 names): device HBM like "full", FLOPs like "selective",
+#                 paid for in PCIe/DMA traffic — the long-sequence lever.
+#   "selective" — save_only_these_names(...) over the named save points
+#                 (models/remat.py CHECKPOINT_NAMES): keep the big matmul
+#                 outputs, recompute only cheap elementwise ops. Megatron's
+#                 "selective" granularity, generalized.
+#   "save_dots" — jax.checkpoint_policies.checkpoint_dots: keep EVERY dot
+#                 output (named or not); FLOP floor, more live HBM.
+#   "none"      — no remat: AD saves whatever it wants (highest memory).
+# ---------------------------------------------------------------------------
+
+REMAT_POLICIES = ("full", "selective", "save_dots", "offload", "none")
+
+# back-compat mapping from the reference's --recompute_granularity surface
+_GRANULARITY_TO_POLICY = {None: "none", "selective": "selective",
+                          "full": "full"}
+
+
+# ---------------------------------------------------------------------------
 # Model architecture
 # ---------------------------------------------------------------------------
 
@@ -80,9 +107,15 @@ class ModelConfig:
     init_method_std: float = 0.02
     use_scaled_init_method: bool = True  # output layers scaled by 1/sqrt(2L)
 
-    # Recompute (ref: arguments.py:606-630)
+    # Recompute (ref: arguments.py:606-630). `recompute_granularity` keeps
+    # the reference vocabulary; `remat_policy` is the first-class policy
+    # name (REMAT_POLICIES above). Give ONE of them — when both are given
+    # they must agree (full<->full, selective<->selective) or __post_init__
+    # raises, so a script can never silently train with the wrong
+    # memory/FLOP trade. `resolved_remat_policy` is what the model reads.
     recompute_granularity: Optional[str] = None  # None | "selective" | "full"
-    recompute_method: str = "uniform"
+    remat_policy: Optional[str] = None  # None | one of REMAT_POLICIES
+    recompute_method: str = "uniform"  # "uniform" | "block"
     recompute_num_layers: int = 1
 
     # Kernels
@@ -113,8 +146,68 @@ class ModelConfig:
         if self.ffn_hidden_size is None:
             object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
         assert self.num_attention_heads % self.num_attention_heads_kv == 0
+        # Recompute-policy validation: unknown strings raise HERE, at config
+        # construction, never downstream as a silently-wrong memory/FLOP
+        # trade (the pre-policy code mapped granularity="selective" to "no
+        # remat at all" without a word).
+        if self.recompute_granularity not in _GRANULARITY_TO_POLICY:
+            raise ValueError(
+                f"recompute_granularity={self.recompute_granularity!r}: "
+                f"expected one of {sorted(k for k in _GRANULARITY_TO_POLICY if k)} "
+                f"or None"
+            )
+        if self.remat_policy is not None \
+                and self.remat_policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r}: expected one of "
+                f"{REMAT_POLICIES} or None"
+            )
+        if self.recompute_method not in ("uniform", "block"):
+            raise ValueError(
+                f"recompute_method={self.recompute_method!r}: expected "
+                f"'uniform' or 'block'"
+            )
+        if (self.remat_policy is not None
+                and self.recompute_granularity is not None
+                and _GRANULARITY_TO_POLICY[self.recompute_granularity]
+                != self.remat_policy):
+            raise ValueError(
+                f"conflicting recompute flags: "
+                f"recompute_granularity={self.recompute_granularity!r} "
+                f"implies remat_policy="
+                f"{_GRANULARITY_TO_POLICY[self.recompute_granularity]!r} "
+                f"but remat_policy={self.remat_policy!r} was given; "
+                f"specify one, or make them agree"
+            )
+        # method/num_layers only do anything under an active policy /
+        # block splits — requesting them in a dead combination is the same
+        # silent-misconfiguration class the checks above exist to reject
+        if self.recompute_method == "block" \
+                and self.resolved_remat_policy == "none":
+            raise ValueError(
+                "recompute_method='block' does nothing without an active "
+                "remat policy: also pass remat_policy "
+                "(full/selective/save_dots/offload) or "
+                "recompute_granularity (full/selective)"
+            )
+        if self.recompute_num_layers != 1 and self.recompute_method != "block":
+            raise ValueError(
+                f"recompute_num_layers={self.recompute_num_layers} is only "
+                f"read by recompute_method='block' (uniform remats every "
+                f"layer); drop it or request block splits"
+            )
 
     # -- derived ----------------------------------------------------------
+    @property
+    def resolved_remat_policy(self) -> str:
+        """The active policy name (one of REMAT_POLICIES): `remat_policy`
+        when given, else the reference-vocabulary mapping of
+        `recompute_granularity` (None->none, selective->selective,
+        full->full). __post_init__ guarantees the two agree."""
+        if self.remat_policy is not None:
+            return self.remat_policy
+        return _GRANULARITY_TO_POLICY[self.recompute_granularity]
+
     @property
     def head_dim(self) -> int:
         return self.kv_channels
@@ -186,13 +279,18 @@ class ParallelConfig:
     num_microbatches: int = 1
     # Pipeline backward rematerialization policy — the memory/FLOP trade
     # 1F1B exists to manage (ref: schedules.py:606-722 trains WITHOUT
-    # recomputing stage internals):
-    #   "tick" (default): jax.checkpoint every scan tick; backward keeps
-    #     only the (b,s,h) boundary carry per tick and recomputes stage
-    #     internals (~+1 forward of FLOPs — the memory-minimal choice);
-    #   "dots":  checkpoint with the dots-saveable policy; matmul outputs
-    #     are kept, only elementwise ops recompute (1F1B-class FLOPs at
-    #     intermediate memory);
+    # recomputing stage internals). Speaks the SAME policy vocabulary as
+    # ModelConfig.remat_policy (REMAT_POLICIES), applied to the per-tick
+    # scan body, plus two legacy aliases:
+    #   "tick" (legacy alias of "full", the default): jax.checkpoint every
+    #     scan tick; backward keeps only the (b,s,h) boundary carry per
+    #     tick and recomputes stage internals (~+1 forward of FLOPs — the
+    #     memory-minimal choice);
+    #   "selective": save_only_these_names over the named save points
+    #     (models/remat.py) — matmul outputs kept, elementwise recomputed;
+    #   "dots" (legacy alias of "save_dots"): checkpoint_dots policy; every
+    #     matmul output is kept (1F1B-class FLOPs at intermediate memory);
+    #   "offload": the selective save set parked in pinned host memory;
     #   "none":  no remat; AD stashes every tick's internals (1F1B-class
     #     FLOPs, highest memory — pick when per-stage HBM allows).
     # Measured FLOPs/memory per policy: docs/PIPELINE_MEMORY.md.
@@ -201,8 +299,19 @@ class ParallelConfig:
     def __post_init__(self):
         if self.tensor_parallel_size == 1 and self.sequence_parallel:
             object.__setattr__(self, "sequence_parallel", False)
-        assert self.pipeline_remat in ("tick", "dots", "none"), \
-            self.pipeline_remat
+        if self.pipeline_remat not in REMAT_POLICIES + ("tick", "dots"):
+            raise ValueError(
+                f"pipeline_remat={self.pipeline_remat!r}: expected one of "
+                f"{REMAT_POLICIES + ('tick', 'dots')}"
+            )
+
+    @property
+    def resolved_pipeline_remat(self) -> str:
+        """pipeline_remat with the legacy aliases normalized to the shared
+        REMAT_POLICIES vocabulary (tick->full, dots->save_dots)."""
+        return {"tick": "full", "dots": "save_dots"}.get(
+            self.pipeline_remat, self.pipeline_remat
+        )
 
     @property
     def world_size(self) -> int:

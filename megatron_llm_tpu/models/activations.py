@@ -13,21 +13,33 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from jax.ad_checkpoint import checkpoint_name
+
+
+def _named(x):
+    """Tag the activation output as the "mlp_act" save point (identity at
+    runtime). Deliberately NOT in the selective save set (models/remat.py):
+    it recomputes elementwise from the saved "mlp_pre_act" projection, and
+    at GLU widths it is the largest tensor the policy gets to drop — the
+    name exists so future policies (and print_saved_residuals audits) can
+    address it."""
+    return checkpoint_name(x, "mlp_act")
+
 
 def liglu(gate, up):
-    return gate * up
+    return _named(gate * up)
 
 
 def geglu(gate, up):
-    return jax.nn.gelu(gate, approximate=False) * up
+    return _named(jax.nn.gelu(gate, approximate=False) * up)
 
 
 def reglu(gate, up):
-    return jax.nn.relu(gate) * up
+    return _named(jax.nn.relu(gate) * up)
 
 
 def swiglu(gate, up):
-    return jax.nn.silu(gate) * up
+    return _named(jax.nn.silu(gate) * up)
 
 
 GLU_ACTIVATIONS = {
@@ -38,10 +50,10 @@ GLU_ACTIVATIONS = {
 }
 
 ACTIVATIONS = {
-    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
-    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
-    "relu": jax.nn.relu,
-    "silu": jax.nn.silu,
+    "gelu": lambda x: _named(jax.nn.gelu(x, approximate=False)),
+    "gelu_tanh": lambda x: _named(jax.nn.gelu(x, approximate=True)),
+    "relu": lambda x: _named(jax.nn.relu(x)),
+    "silu": lambda x: _named(jax.nn.silu(x)),
 }
 
 

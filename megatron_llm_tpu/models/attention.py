@@ -27,6 +27,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from megatron_llm_tpu.models.remat import tag as _savepoint
 from megatron_llm_tpu.models.rope import apply_rope
 from megatron_llm_tpu.parallel.mesh import (
     CONTEXT_AXIS,
@@ -174,15 +175,20 @@ def cross_attention_block(
         q = q + attn_params["bq"].astype(dt).reshape(g, qpk, d)
     if "bkv" in attn_params:
         kv = kv + attn_params["bkv"].astype(dt)
+    # same named save points as self-attention (models/remat.py): the q and
+    # kv projections both carry the "qkv_proj" name
+    q = _savepoint(q, "qkv_proj")
+    kv = _savepoint(kv, "qkv_proj")
     t = encoder_output.shape[1]
     kv = kv.reshape(b, t, g, 2, d)
     k, v = kv[:, :, :, 0], kv[:, :, :, 1]
     q = shard_activation(q, "groups")
     ctx = grouped_attention(q, k, v, mask, cfg, dropout_rng, deterministic)
+    ctx = _savepoint(ctx, "attn_ctx")
     out = ctx @ attn_params["wo"].astype(dt)
     if "bo" in attn_params:
         out = out + attn_params["bo"].astype(dt)
-    return out
+    return _savepoint(out, "attn_dense")
 
 
 def padding_mask_2d(q_keep: jnp.ndarray,
@@ -235,6 +241,10 @@ def attention_block(
     mixed = hidden @ attn_params["wqkv"].astype(compute_dtype)
     if "bqkv" in attn_params:
         mixed = mixed + attn_params["bqkv"].astype(compute_dtype)
+    # named save point: under remat_policy selective/offload the fused QKV
+    # projection is kept for backward; q/k/v (incl. RoPE) rebuild from it
+    # with elementwise ops only (models/remat.py)
+    mixed = _savepoint(mixed, "qkv_proj")
     q, k, v = split_qkv(mixed, cfg)
     q = shard_activation(q, "groups")
 
@@ -391,27 +401,28 @@ def attention_block(
             and doc_start is None
         if ring_ok:
             ctx = _ring_dispatch(pctx, q, k, v, doc_start=doc_start)
-            ctx = ctx.reshape(b, s, -1)
+            ctx = _savepoint(ctx, "attn_ctx").reshape(b, s, -1)
         elif flash_ok:
             from megatron_llm_tpu.ops.flash_attention import flash_attention
 
+            # flash output + logsumexp are tagged INSIDE the wrapper
+            # ("attn_ctx"/"flash_lse", ops/flash_attention.py) so the
+            # selective policy can keep both and the backward never
+            # re-runs the forward kernel
             ctx = flash_attention(q, k, v, causal=True)
             ctx = ctx.reshape(b, s, -1)
         else:
             if mask is None:
                 mask = causal_mask(s)
-            core = lambda q_, k_, v_, m_: grouped_attention(  # noqa: E731
-                q_, k_, v_, m_, cfg, dropout_rng, deterministic
-            )
-            if cfg.recompute_granularity == "selective":
-                # Selective recompute = don't save the O(s*t) softmax
-                # probabilities for backward; recompute core attention from
-                # the saved q/k/v (ref: --recompute-granularity selective,
-                # transformer.py:357-401 checkpoints CoreAttention only).
-                # The flash path needs no remat: its custom VJP already
-                # recomputes scores tile-by-tile.
-                core = jax.checkpoint(core)
-            ctx = core(q, k, v, mask)
+            # The O(s*t) softmax probabilities are NOT a named save point:
+            # under any remat policy but "none" they are recomputed from
+            # the saved "qkv_proj" (the reference's selective-granularity
+            # behavior, ref: transformer.py:357-401, now expressed by the
+            # name policy in models/remat.py rather than a nested
+            # jax.checkpoint around the core).
+            ctx = grouped_attention(q, k, v, mask, cfg, dropout_rng,
+                                    deterministic)
+            ctx = _savepoint(ctx, "attn_ctx")
         new_cache = None
 
     ctx = shard_activation(
@@ -421,4 +432,5 @@ def attention_block(
     out = ctx @ attn_params["wo"].astype(compute_dtype)
     if "bo" in attn_params:
         out = out + attn_params["bo"].astype(compute_dtype)
+    out = _savepoint(out, "attn_dense")
     return out, new_cache

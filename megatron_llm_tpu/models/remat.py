@@ -1,0 +1,118 @@
+"""Named-savepoint activation-recompute policies.
+
+The reference trades memory for FLOPs with --recompute_granularity
+(ref: arguments.py:606-630, random.py:175-247): "full" re-runs every layer
+in backward, "selective" keeps everything EXCEPT the O(s^2) attention core.
+Here the same ladder — and two rungs the reference doesn't have — is built
+from jax.checkpoint policies over NAMED save points: the expensive matmul
+outputs are tagged with `jax.ad_checkpoint.checkpoint_name` at their
+definition sites, and each policy decides which names survive to backward.
+
+Save-point names (tagged once per runtime path):
+
+| name          | tensor                          | tagged in             |
+|---------------|---------------------------------|-----------------------|
+| `qkv_proj`    | fused QKV projection output     | models/attention.py   |
+| `attn_ctx`    | attention context (flash out /  | ops/flash_attention.py|
+|               | ring / grouped einsum output)   | + models/attention.py |
+| `flash_lse`   | flash kernel row logsumexp      | ops/flash_attention.py|
+|               | (custom-VJP residual; saving it |                       |
+|               | + attn_ctx means backward never |                       |
+|               | re-runs the forward kernel)     |                       |
+| `attn_dense`  | attention output projection     | models/attention.py   |
+| `mlp_pre_act` | pre-GLU/act MLP up-projection   | models/transformer.py |
+| `mlp_act`     | activation/GLU-combine output   | models/activations.py |
+| `mlp_out`     | MLP down-projection output      | models/transformer.py |
+
+Policies (ModelConfig.remat_policy / ParallelConfig.pipeline_remat):
+
+- "full":      checkpoint with no policy — save only what crosses the
+               checkpoint boundary, recompute everything (+~1/3 FLOPs).
+- "selective": save_only_these_names(SELECTIVE_SAVE_NAMES) — every matmul
+               output above EXCEPT `mlp_act` (elementwise, cheap to
+               recompute from the saved `mlp_pre_act`); backward recomputes
+               only elementwise ops (norms, GLU, rope, residual adds) and
+               the attention core stays free via the flash custom VJP.
+- "save_dots": jax.checkpoint_policies.checkpoint_dots — keep every dot
+               output, named or not (FLOP floor, more live HBM).
+- "offload":   the selective save set, parked in PINNED HOST memory
+               (save_and_offload_only_these_names) — device HBM like
+               "full", FLOPs like "selective", paid in host-DMA traffic;
+               the long-sequence lever.
+- "none":      no checkpoint wrapper at all.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.ad_checkpoint import checkpoint_name
+
+from megatron_llm_tpu.config import REMAT_POLICIES
+
+# every tagged save point (see the table above)
+CHECKPOINT_NAMES = (
+    "qkv_proj",
+    "attn_ctx",
+    "flash_lse",
+    "attn_dense",
+    "mlp_pre_act",
+    "mlp_act",
+    "mlp_out",
+)
+
+# what "selective" keeps: the matmul outputs (+ the tiny flash logsumexp
+# rows so backward never re-runs the forward flash kernel). `mlp_act` is
+# deliberately absent — it is elementwise-recomputable from `mlp_pre_act`
+# for free, and at GLU widths it is the single largest remaining tensor.
+SELECTIVE_SAVE_NAMES = (
+    "qkv_proj",
+    "attn_ctx",
+    "flash_lse",
+    "attn_dense",
+    "mlp_pre_act",
+    "mlp_out",
+)
+
+# the offload policy ships the same set to pinned host memory
+OFFLOAD_NAMES = SELECTIVE_SAVE_NAMES
+
+
+def tag(x, name: str):
+    """Tag a tensor as a named save point (identity at runtime)."""
+    assert name in CHECKPOINT_NAMES, name
+    return checkpoint_name(x, name)
+
+
+def remat_policy_fn(policy: str):
+    """Policy name -> the jax.checkpoint `policy=` callable (None for the
+    "full" no-policy checkpoint). Callers must special-case "none" (no
+    checkpoint wrapper); `remat_wrap` below does."""
+    cp = jax.checkpoint_policies
+    if policy == "full":
+        return None
+    if policy == "selective":
+        return cp.save_only_these_names(*SELECTIVE_SAVE_NAMES)
+    if policy == "save_dots":
+        return cp.checkpoint_dots
+    if policy == "offload":
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=list(OFFLOAD_NAMES),
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
+    raise ValueError(
+        f"remat policy {policy!r}: expected one of {REMAT_POLICIES}"
+    )
+
+
+def remat_wrap(fn, policy: str, prevent_cse: bool = False):
+    """Apply the named remat policy to `fn` (a scan body / pipeline tick).
+    "none" returns `fn` untouched; everything else is jax.checkpoint with
+    the matching saveable policy. prevent_cse=False is safe under scan
+    (the standard remat-in-scan setting used throughout this repo)."""
+    if policy == "none":
+        return fn
+    return jax.checkpoint(
+        fn, prevent_cse=prevent_cse, policy=remat_policy_fn(policy)
+    )

@@ -111,8 +111,12 @@ def decoder_stack(layer_params, cfg, hidden, encoder_output, self_mask,
         h = shard_activation(h, "hidden")
         return (h,), None
 
-    if cfg.recompute_granularity == "full":
-        body = jax.checkpoint(body, prevent_cse=False)
+    # same named-savepoint policy ladder as the decoder-only stack
+    # (models/remat.py); the cross-attention projections carry the shared
+    # save-point names so selective/offload cover T5 too
+    from megatron_llm_tpu.models.remat import remat_wrap
+
+    body = remat_wrap(body, cfg.resolved_remat_policy)
     L = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
     (hidden,), _ = jax.lax.scan(body, (hidden,),
                                 (layer_params, jnp.arange(L)))

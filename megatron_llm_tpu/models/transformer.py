@@ -8,8 +8,10 @@ departures:
   single `jax.lax.scan`, so the whole model compiles once regardless of
   depth (the reference's Python per-layer loop, transformer.py:1236-1242,
   is a CUDA-graph idiom XLA doesn't need).
-- Activation recompute is `jax.checkpoint` on the scanned body
-  (ref: recompute_granularity arguments.py:606-630, random.py:175-247).
+- Activation recompute is `jax.checkpoint` on the scanned body, driven by
+  the named-savepoint policy ladder (models/remat.py;
+  ModelConfig.remat_policy full/selective/save_dots/offload/none —
+  ref: recompute_granularity arguments.py:606-630, random.py:175-247).
 - Residual structure covers pre/post-LN, Falcon parallel-attention and
   parallel-layernorm variants (ref: transformer.py:613-634, 774-806).
 """
@@ -25,6 +27,7 @@ import jax.numpy as jnp
 from megatron_llm_tpu.models.activations import ACTIVATIONS, GLU_ACTIVATIONS
 from megatron_llm_tpu.models.attention import attention_block
 from megatron_llm_tpu.models.norms import apply_norm
+from megatron_llm_tpu.models.remat import remat_wrap, tag as _savepoint
 from megatron_llm_tpu.parallel.mesh import shard_activation
 
 
@@ -127,6 +130,10 @@ def mlp_block(mlp_params, cfg, hidden, dropout_rng, deterministic):
             x = jnp.einsum("bsh,hcf->bscf", hidden, w1)
         if "b1" in mlp_params:
             x = x + mlp_params["b1"].astype(dt)
+        # named save point: the pre-GLU up-projection — what the selective
+        # policy keeps so the gate/up GEMM never re-runs in backward (the
+        # GLU combine itself is the unnamed-elementwise part it recomputes)
+        x = _savepoint(x, "mlp_pre_act")
         x = shard_activation(x, "glu_ffn")
         act = GLU_ACTIVATIONS[cfg.glu_activation]
         x = act(x[..., 0, :], x[..., 1, :])
@@ -134,12 +141,13 @@ def mlp_block(mlp_params, cfg, hidden, dropout_rng, deterministic):
         x = hidden @ w1
         if "b1" in mlp_params:
             x = x + mlp_params["b1"].astype(dt)
+        x = _savepoint(x, "mlp_pre_act")
         x = ACTIVATIONS[cfg.hidden_act](x)
     x = shard_activation(x, "ffn")
     x = x @ mlp_params["w2"].astype(dt)
     if "b2" in mlp_params:
         x = x + mlp_params["b2"].astype(dt)
-    return x
+    return _savepoint(x, "mlp_out")
 
 
 def _dropout(x, rate, rng, deterministic):
@@ -255,18 +263,22 @@ def transformer_stack(
         )
         return (out,), new_cache_l
 
-    # How many layers get full recompute (ref: --recompute-method
-    # arguments.py:616-630): "uniform" remats every layer (each scan step
-    # checkpointed); "block" remats only the first recompute_num_layers —
+    # Which remat policy wraps the scan body (models/remat.py): "full"
+    # saves only the boundary carry, "selective"/"offload" keep the named
+    # matmul outputs (on device / in pinned host), "save_dots" keeps every
+    # dot, "none" skips the wrapper. How MANY layers get it follows
+    # --recompute_method (ref: arguments.py:616-630): "uniform" remats
+    # every layer; "block" remats only the first recompute_num_layers —
     # the rest keep their activations, soaking up whatever HBM is left.
-    if cfg.recompute_granularity == "full":
+    policy = cfg.resolved_remat_policy
+    if policy != "none":
         if cfg.recompute_method == "block":
             n_remat = min(cfg.recompute_num_layers, L)
         else:
             n_remat = L
     else:
         n_remat = 0
-    body_ck = jax.checkpoint(body, prevent_cse=False)
+    body_ck = remat_wrap(body, policy)
 
     idxs = layer_offset + jnp.arange(L)
     if unrolled:
@@ -306,8 +318,7 @@ def transformer_stack(
             (out,), new_cache_l = body((hidden,), (params_l, idx, cache_l))
             return (out, new_cache_l["k"], new_cache_l["v"]), None
 
-        f = jax.checkpoint(cache_body, prevent_cse=False) \
-            if n_remat == L else cache_body
+        f = remat_wrap(cache_body, policy) if n_remat == L else cache_body
         (hidden, kc, vc), _ = jax.lax.scan(
             f, (hidden, kv_caches["k"], kv_caches["v"]),
             (layer_params, idxs),

@@ -549,7 +549,15 @@ def _flash(config, q, k, v):
 def _flash_fwd_rule(config, q, k, v):
     causal, block_q, block_k, interpret = config
     o, lse = _flash_fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+    # Named save points on the residuals (models/remat.py): when an outer
+    # jax.checkpoint runs a selective/offload policy, keeping o AND the
+    # (tiny, b*s*heads fp32) lse rows means the backward consumes the
+    # saved residuals directly — the forward kernel is never re-run; only
+    # the bwd kernels (which recompute scores tile-by-tile) execute.
+    from jax.ad_checkpoint import checkpoint_name
+
+    return o, (q, k, v, checkpoint_name(o, "attn_ctx"),
+               checkpoint_name(lse, "flash_lse"))
 
 
 def _flash_bwd_rule(config, residuals, g):
@@ -656,12 +664,20 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """GQA flash attention, differentiable. Returns (b, s, g, qpk, d)."""
+    """GQA flash attention, differentiable. Returns (b, s, g, qpk, d).
+
+    The output is tagged as the "attn_ctx" named save point (and the
+    custom-VJP residuals tag o/lse, see _flash_fwd_rule) so the
+    named-savepoint remat policies (models/remat.py) can keep it."""
+    from jax.ad_checkpoint import checkpoint_name
+
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         blocks = _pick_blocks(q.shape[1], k.shape[1], q.shape[-1],
                               q.shape[3], block_q, block_k)
         if blocks is not None:
-            return _flash((causal, *blocks, interpret), q, k, v)
-    return _xla_reference(q, k, v, causal)
+            return checkpoint_name(
+                _flash((causal, *blocks, interpret), q, k, v), "attn_ctx"
+            )
+    return checkpoint_name(_xla_reference(q, k, v, causal), "attn_ctx")

@@ -28,8 +28,10 @@ thing 1F1B exists to bound (per-stage live activation memory,
 schedules.py:606-722) is bounded here differently and harder: by default
 every tick body is `jax.checkpoint`ed, so the backward keeps ONLY the
 (b, s, h) boundary carry per tick and recomputes stage internals.
-`ParallelConfig.pipeline_remat` ("tick"/"dots"/"none") trades that memory
-floor back for 1F1B-class FLOPs when per-stage HBM allows — measured in
+`ParallelConfig.pipeline_remat` — the shared named-savepoint policy
+vocabulary of models/remat.py ("tick"/"full", "selective", "dots"/
+"save_dots", "offload", "none") — trades that memory floor back for
+1F1B-class FLOPs when per-stage HBM allows — measured in
 docs/PIPELINE_MEMORY.md ("dots" hits the FLOP floor at intermediate
 memory). 1F1B keeps <=pp
 in-flight stashes of a stage's FULL internal activations (~tens of b*s*h
@@ -321,21 +323,20 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 )
                 return (state, sums, denoms), None
 
-            # Backward memory policy (ParallelConfig.pipeline_remat):
-            # "tick" keeps only the tick-boundary carries and recomputes
-            # stage internals (the TPU answer to deallocate_output_tensor +
-            # 1F1B's bounded stash, schedules.py:36-88); "dots" keeps matmul
-            # outputs (1F1B-class FLOPs, intermediate memory); "none" keeps
-            # everything (1F1B-class FLOPs, what the reference's no-remat
-            # 1F1B pays in memory). Measured: docs/PIPELINE_MEMORY.md.
-            remat = getattr(pcfg, "pipeline_remat", "tick")
-            if remat == "tick":
-                tick = jax.checkpoint(tick, prevent_cse=False)
-            elif remat == "dots":
-                tick = jax.checkpoint(
-                    tick, prevent_cse=False,
-                    policy=jax.checkpoint_policies.checkpoint_dots,
-                )
+            # Backward memory policy (ParallelConfig.pipeline_remat) —
+            # the SAME named-savepoint vocabulary as the single-mesh stack
+            # (models/remat.py): "tick"/"full" keeps only the tick-boundary
+            # carries and recomputes stage internals (the TPU answer to
+            # deallocate_output_tensor + 1F1B's bounded stash,
+            # schedules.py:36-88); "selective" keeps the named matmul
+            # outputs; "dots"/"save_dots" keeps every dot (1F1B-class
+            # FLOPs, intermediate memory); "offload" parks the selective
+            # set in pinned host memory; "none" keeps everything
+            # (1F1B-class FLOPs, what the reference's no-remat 1F1B pays
+            # in memory). Measured: docs/PIPELINE_MEMORY.md.
+            from megatron_llm_tpu.models.remat import remat_wrap
+
+            tick = remat_wrap(tick, pcfg.resolved_pipeline_remat)
 
             # carries become stage-varying inside the loop; mark the zero
             # initials as varying so the scan carry types are stable

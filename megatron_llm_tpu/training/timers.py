@@ -55,6 +55,23 @@ class Timers:
         self._log_option = log_option
         self._timers: dict = {}
         self._log_levels: dict = {}
+        # one-shot run facts (remat policy, compiled temp/args bytes, ...)
+        # recorded once and carried alongside the timers so a perf
+        # trajectory is attributable to the configuration that produced it
+        self._gauges: dict = {}
+        self._gauges_unprinted: set = set()
+        self._gauges_unwritten: set = set()
+
+    def gauge(self, name: str, value):
+        """Record a one-shot named value (number or string). Surfaced ONCE
+        per channel: printed by the next `log()` and written by the next
+        `write()` after being set (re-setting re-arms both)."""
+        self._gauges[name] = value
+        self._gauges_unprinted.add(name)
+        self._gauges_unwritten.add(name)
+
+    def gauges(self) -> dict:
+        return dict(self._gauges)
 
     def __call__(self, name: str, log_level: Optional[int] = None) -> _Timer:
         if name not in self._timers:
@@ -78,6 +95,11 @@ class Timers:
                 continue
             t = self._timers[name].elapsed(reset=reset) * 1000.0 / normalizer
             parts.append(f"{name}: {t:.2f}")
+        if self._gauges_unprinted:
+            gparts = [f"{n}: {self._gauges[n]}"
+                      for n in self._gauges if n in self._gauges_unprinted]
+            self._gauges_unprinted.clear()
+            print("run facts | " + " | ".join(gparts), flush=True)
         if not parts:
             return None
         line = "time (ms) | " + " | ".join(parts)
@@ -86,8 +108,19 @@ class Timers:
 
     def write(self, names: List[str], writer, iteration: int,
               normalizer: float = 1.0, reset: bool = False):
-        """ref: Timers.write (timers.py:280-300) — tensorboard dump."""
+        """ref: Timers.write (timers.py:280-300) — tensorboard dump.
+        Gauges not yet written ride along once (numeric via add_scalar,
+        strings — e.g. the remat policy — via add_text when supported)."""
         for name in names:
             if name in self._timers:
                 value = self._timers[name].elapsed(reset=reset) / normalizer
                 writer.add_scalar(f"{name}-time", value, iteration)
+        for name in [n for n in self._gauges if n in self._gauges_unwritten]:
+            value = self._gauges[name]
+            if isinstance(value, (int, float)):
+                writer.add_scalar(name, value, iteration)
+            elif hasattr(writer, "add_text"):
+                writer.add_text(name, str(value), iteration)
+            # consumed either way: a writer with no text sink will never
+            # grow one, so retrying a string gauge forever is pointless
+            self._gauges_unwritten.discard(name)

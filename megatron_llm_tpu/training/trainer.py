@@ -139,6 +139,7 @@ class Trainer:
         self.timers = Timers(tcfg.timing_log_level, tcfg.timing_log_option)
         self._n_params = 0  # set in setup(); enables the TFLOP/s log field
         self._trace_active = False
+        self._run_facts_logged = False
         self.ctx = get_context()
         self._eval_step_fn = None
 
@@ -313,6 +314,40 @@ class Trainer:
         return self._train_steps[num_microbatches]
 
     # ------------------------------------------------------------------
+    def _log_run_facts(self, step_fn, lower_args):
+        """Once, at step 0: the active remat policy — and, under
+        --log_memory_to_tensorboard, the compiled per-device temp/args
+        bytes of the exact train step — so a WandB/tensorboard perf
+        trajectory is attributable to the memory/FLOP trade in effect
+        (the step-0 analogue of bench.py's remat sweep). The memory
+        analysis is opt-in because on this JAX line .lower().compile()
+        does not reuse the jit call cache: it pays one extra full compile
+        of the train step."""
+        self._run_facts_logged = True
+        facts = {"remat-policy": self.cfg.resolved_remat_policy}
+        if self.pcfg.pipeline_parallel_size > 1:
+            facts["pipeline-remat"] = self.pcfg.resolved_pipeline_remat
+        if self._tb_writer is not None \
+                and self.tcfg.log_memory_to_tensorboard:
+            try:
+                mem = step_fn.lower(*lower_args).compile().memory_analysis()
+                facts["compiled-temp-bytes"] = int(mem.temp_size_in_bytes)
+                facts["compiled-args-bytes"] = int(
+                    mem.argument_size_in_bytes
+                )
+            except Exception as e:
+                print(f"step-0 memory analysis unavailable: {e}",
+                      flush=True)
+        for k, v in facts.items():
+            self.timers.gauge(k, v)
+        self.timers.log([])  # surfaces the new gauges once, right now
+        if self._tb_writer is not None:
+            # tensorboard via the timers' once-per-channel gauge ride-along;
+            # the wandb shim additionally lands them in the run CONFIG
+            self.timers.write([], self._tb_writer, 0)
+            if hasattr(self._tb_writer, "log_run_metadata"):
+                self._tb_writer.log_run_metadata(facts)
+
     def train_step(self, state: TrainState, text: np.ndarray, dropout_rng=None):
         """One optimizer step over a global batch 'text'
         (num_micro, mbs*dp, seq+1) array, or a dict of such arrays when a
@@ -345,12 +380,22 @@ class Trainer:
 
             batch = globalize_batch(batch, self.ctx)
         step_fn = self._get_step_fn(num_micro)
+        first_step = state.iteration == 0 and not self._run_facts_logged
         params, opt_state, stats = step_fn(
             state.params, state.opt_state, batch,
             jnp.float32(lr), jnp.float32(wd), dropout_rng,
         )
         state.params = params
         state.opt_state = opt_state
+        if first_step:
+            # AFTER the first execution (avals of the donated args are
+            # unchanged, and the opt-in memory relower never races the
+            # step's own compile)
+            self._log_run_facts(
+                step_fn,
+                (params, opt_state, batch, jnp.float32(lr),
+                 jnp.float32(wd), dropout_rng),
+            )
         state.iteration += 1
         mbs_dp = jax.tree.leaves(batch)[0].shape[1]
         # samples mode: the scheduler advances by samples consumed this

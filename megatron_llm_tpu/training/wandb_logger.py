@@ -56,6 +56,18 @@ class WandbTBShim:
         if self._tb is not None:
             self._tb.add_text(name, text, iteration)
 
+    def log_run_metadata(self, metadata: dict):
+        """One-shot run facts (active remat policy, compiled per-device
+        temp/args bytes, ...) — lands in the wandb run CONFIG, so runs are
+        filterable/groupable by it in the UI, not buried in a scalar
+        stream. (The tensorboard copy arrives separately via the timers'
+        gauge ride-along — no mirroring here, or it would land twice.)"""
+        if self._run is not None:
+            try:
+                self._run.config.update(metadata, allow_val_change=True)
+            except Exception:
+                pass
+
     def flush(self):
         if self._run is not None:
             for it in sorted(self._pending):

@@ -123,6 +123,9 @@ OVERRIDE_VALUES = {
     "--lr_decay_style": ["cosine"],
     "--weight_decay_incr_style": ["linear"],
     "--recompute_granularity": ["full"],
+    # "uniform" is the ModelConfig default — only "block" is an effect
+    "--recompute_method": ["block"],
+    "--recompute_num_layers": ["3"],
 }
 
 # Companion args a flag needs to form a valid config (the flag's effect is
@@ -135,6 +138,11 @@ EXTRA_ARGS = {
                             "--micro_batch_size", "1"],
     # sp is normalized away at tp=1; judge it on a tp=2 baseline
     "--sequence_parallel": ["--tensor_model_parallel_size", "2"],
+    # block/num_layers without an active remat policy raise loudly
+    # (ModelConfig validation); judge them on a granularity-full baseline
+    "--recompute_method": ["--recompute_granularity", "full"],
+    "--recompute_num_layers": ["--recompute_granularity", "full",
+                               "--recompute_method", "block"],
     # gpt defaults use_bias=True; judge on llama (default False)
     "--use_bias": ["--model_name", "llama2", "--model_size", "7"],
 }
@@ -229,6 +237,59 @@ def test_entry_script_flags_are_registered_there():
             assert f'"{flag}"' in src or f"'{flag}'" in src, (
                 f"{flag} claimed to be handled by {script} but not found"
             )
+
+
+def test_remat_policy_flag_has_effect():
+    """--remat_policy (beyond-reference flag) must land in ModelConfig."""
+    p = build_base_parser()
+    base, _, _, _ = args_to_configs(p.parse_args([]), 50257)
+    for pol in ("full", "selective", "save_dots", "offload", "none"):
+        mcfg, _, _, _ = args_to_configs(
+            p.parse_args(["--remat_policy", pol]), 50257
+        )
+        assert mcfg.remat_policy == pol
+        assert mcfg.resolved_remat_policy == pol
+    assert base.remat_policy is None
+    assert base.resolved_remat_policy == "none"
+
+
+def test_remat_policy_recompute_flags_conflict_loudly():
+    """--remat_policy and the reference --recompute_* spellings must agree
+    or fail at config validation — never silently train with the wrong
+    memory/FLOP trade."""
+    p = build_base_parser()
+    # consistent combinations parse
+    for argv in (
+        ["--remat_policy", "full", "--recompute_granularity", "full"],
+        ["--remat_policy", "selective", "--recompute_granularity",
+         "selective"],
+        ["--remat_policy", "selective", "--recompute_activations"],
+        ["--remat_policy", "save_dots"],
+        ["--recompute_granularity", "full", "--recompute_method", "block",
+         "--recompute_num_layers", "2"],
+    ):
+        mcfg, _, _, _ = args_to_configs(p.parse_args(argv), 50257)
+        assert mcfg.resolved_remat_policy != "bogus"
+    # inconsistent combinations raise
+    for argv in (
+        ["--remat_policy", "none", "--recompute_granularity", "full"],
+        ["--remat_policy", "full", "--recompute_granularity", "selective"],
+        ["--remat_policy", "save_dots", "--recompute_activations"],
+        ["--remat_policy", "offload", "--recompute_granularity", "full"],
+    ):
+        with pytest.raises((ValueError, SystemExit)):
+            args_to_configs(p.parse_args(argv), 50257)
+
+
+def test_recompute_activations_shorthand_selects_selective_policy():
+    """The ref shorthand (and plain --recompute_granularity selective) must
+    resolve to the REAL selective policy — the pre-policy code silently
+    mapped it to 'no remat at all'."""
+    p = build_base_parser()
+    for argv in (["--recompute_activations"],
+                 ["--recompute_granularity", "selective"]):
+        mcfg, _, _, _ = args_to_configs(p.parse_args(argv), 50257)
+        assert mcfg.resolved_remat_policy == "selective", argv
 
 
 def test_supported_reference_flags_have_effect():

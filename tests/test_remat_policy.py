@@ -1,0 +1,257 @@
+"""Named-savepoint remat-policy subsystem (models/remat.py).
+
+Three properties, each of which fails loudly instead of showing up as an
+OOM (or a silent +1/3 FLOP tax) at scale:
+
+1. PARITY — remat changes WHEN things are computed, never WHAT: loss and
+   every grad leaf are bitwise-identical across the whole policy ladder
+   (none/full/selective/save_dots/offload) and across recompute_method
+   uniform vs block (the split-scan path in models/transformer.py),
+   including the dropout `fold_in(idx)` layer indexing under block splits.
+2. MEMORY ORDERING — compiled peak temp bytes obey
+   none >= save_dots >= selective >= full (CPU memory_analysis), so a
+   policy regression (e.g. selective quietly degrading to no-remat — the
+   exact pre-policy bug) fails here, not as an OOM on a pod.
+3. RESOLUTION — the reference's recompute_granularity vocabulary maps onto
+   the policy ladder, and unknown/conflicting strings raise at config
+   construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import REMAT_POLICIES, tiny_config
+from megatron_llm_tpu.models import LlamaModel
+from megatron_llm_tpu.models.remat import (
+    CHECKPOINT_NAMES,
+    SELECTIVE_SAVE_NAMES,
+    remat_policy_fn,
+    remat_wrap,
+)
+
+
+def _base_cfg(**over):
+    # dropout ON so the fold_in(idx) layer-keying is part of what parity
+    # pins; 4 layers so block splits (2 remat + 2 plain scans) are real
+    over.setdefault("num_layers", 4)
+    over.setdefault("hidden_dropout", 0.1)
+    return tiny_config(**over)
+
+
+def _loss_and_grads(cfg, tokens, labels, rng):
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+
+    def loss(p):
+        return model.loss(p, tokens, labels, dropout_rng=rng,
+                          deterministic=False)
+
+    return jax.jit(jax.value_and_grad(loss))(params)
+
+
+def _assert_bitwise(ref, out, label):
+    ref_l, ref_g = ref
+    out_l, out_g = out
+    assert np.array_equal(np.asarray(ref_l), np.asarray(out_l)), (
+        label, float(ref_l), float(out_l)
+    )
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(ref_g),
+        jax.tree_util.tree_leaves_with_path(out_g),
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (label, path)
+
+
+# ---------------------------------------------------------------------------
+# 1. parity
+# ---------------------------------------------------------------------------
+
+
+def test_policies_bitwise_identical():
+    cfg = _base_cfg()
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, 256, (2, 64)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 256, (2, 64)), jnp.int32)
+    rng = jax.random.key(7)
+
+    ref = _loss_and_grads(
+        dataclasses.replace(cfg, remat_policy="none"), tokens, labels, rng
+    )
+    for pol in ("full", "selective", "save_dots", "offload"):
+        out = _loss_and_grads(
+            dataclasses.replace(cfg, remat_policy=pol), tokens, labels, rng
+        )
+        _assert_bitwise(ref, out, pol)
+
+
+def test_block_vs_uniform_bitwise_identical():
+    """recompute_method block (split scan: remat'd prefix + plain suffix)
+    must not disturb the per-layer dropout keys or the math, for every
+    policy it composes with."""
+    cfg = _base_cfg()
+    rs = np.random.RandomState(1)
+    tokens = jnp.asarray(rs.randint(0, 256, (2, 64)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 256, (2, 64)), jnp.int32)
+    rng = jax.random.key(11)
+
+    ref = _loss_and_grads(
+        dataclasses.replace(cfg, remat_policy="none"), tokens, labels, rng
+    )
+    for pol in ("full", "selective"):
+        for n in (1, 2, 4):  # 4 == num_layers: block degenerates to uniform
+            out = _loss_and_grads(
+                dataclasses.replace(
+                    cfg, remat_policy=pol, recompute_method="block",
+                    recompute_num_layers=n,
+                ),
+                tokens, labels, rng,
+            )
+            _assert_bitwise(ref, out, (pol, "block", n))
+
+
+def test_reference_granularity_spelling_parity():
+    """The reference --recompute_granularity spellings route through the
+    same policies (selective no longer degrades to no-remat)."""
+    cfg = _base_cfg()
+    rs = np.random.RandomState(2)
+    tokens = jnp.asarray(rs.randint(0, 256, (2, 64)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 256, (2, 64)), jnp.int32)
+    rng = jax.random.key(13)
+
+    ref = _loss_and_grads(cfg, tokens, labels, rng)  # granularity None
+    for gran in ("selective", "full"):
+        out = _loss_and_grads(
+            dataclasses.replace(cfg, recompute_granularity=gran),
+            tokens, labels, rng,
+        )
+        _assert_bitwise(ref, out, gran)
+
+
+# ---------------------------------------------------------------------------
+# 2. memory ordering
+# ---------------------------------------------------------------------------
+
+
+def _compiled_temp_bytes(cfg, tokens, labels):
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    compiled = jax.jit(jax.value_and_grad(model.loss)).lower(
+        params, tokens, labels
+    ).compile()
+    return compiled.memory_analysis().temp_size_in_bytes
+
+
+def test_policy_memory_ordering():
+    """Peak compiled temp memory must be ordered
+    none >= save_dots >= selective >= full — the ladder's whole point.
+    A config big enough that the saved activations dominate transients."""
+    cfg = tiny_config(
+        num_layers=6, hidden_size=128, num_attention_heads=8,
+        num_attention_heads_kv=8, ffn_hidden_size=512, seq_length=256,
+        max_position_embeddings=256, padded_vocab_size=512,
+    )
+    rs = np.random.RandomState(3)
+    tokens = jnp.asarray(rs.randint(0, 512, (4, 256)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 512, (4, 256)), jnp.int32)
+
+    temp = {
+        pol: _compiled_temp_bytes(
+            dataclasses.replace(cfg, remat_policy=pol), tokens, labels
+        )
+        for pol in ("none", "save_dots", "selective", "full")
+    }
+    print({k: round(v / 2**20, 1) for k, v in temp.items()}, "MB")
+    assert temp["none"] >= temp["save_dots"] >= temp["selective"] \
+        >= temp["full"], temp
+    # the interesting gaps must be STRICT, not a wash: selective saves
+    # real memory over no-remat, full saves real memory over selective
+    assert temp["selective"] < 0.9 * temp["none"], temp
+    assert temp["full"] < 0.9 * temp["selective"], temp
+
+
+# ---------------------------------------------------------------------------
+# 3. resolution / registry
+# ---------------------------------------------------------------------------
+
+
+def test_granularity_maps_to_policy():
+    assert tiny_config().resolved_remat_policy == "none"
+    assert tiny_config(
+        recompute_granularity="selective"
+    ).resolved_remat_policy == "selective"
+    assert tiny_config(
+        recompute_granularity="full"
+    ).resolved_remat_policy == "full"
+    for pol in REMAT_POLICIES:
+        assert tiny_config(remat_policy=pol).resolved_remat_policy == pol
+
+
+def test_unknown_and_conflicting_strings_raise():
+    with pytest.raises(ValueError):
+        tiny_config(recompute_granularity="selectiv")
+    with pytest.raises(ValueError):
+        tiny_config(remat_policy="dots")  # pipeline alias, not a model one
+    with pytest.raises(ValueError):
+        tiny_config(recompute_method="blocks")
+    with pytest.raises(ValueError):
+        tiny_config(recompute_granularity="full", remat_policy="selective")
+    with pytest.raises(ValueError):
+        tiny_config(recompute_granularity="selective", remat_policy="none")
+    # dead combinations are loud too: block/num_layers do nothing without
+    # an active policy, so requesting them that way is an error
+    with pytest.raises(ValueError):
+        tiny_config(recompute_method="block")
+    with pytest.raises(ValueError):
+        tiny_config(recompute_granularity="full", recompute_num_layers=2)
+    # agreeing spellings are fine
+    tiny_config(recompute_granularity="full", remat_policy="full")
+    tiny_config(recompute_granularity="full", recompute_method="block",
+                recompute_num_layers=2)
+
+
+def test_pipeline_remat_vocabulary():
+    from megatron_llm_tpu.config import ParallelConfig
+
+    assert ParallelConfig(pipeline_remat="tick") \
+        .resolved_pipeline_remat == "full"
+    assert ParallelConfig(pipeline_remat="dots") \
+        .resolved_pipeline_remat == "save_dots"
+    for pol in REMAT_POLICIES:
+        assert ParallelConfig(pipeline_remat=pol) \
+            .resolved_pipeline_remat == pol
+    with pytest.raises(ValueError):
+        ParallelConfig(pipeline_remat="ticks")
+
+
+def test_registry_covers_policies_and_names():
+    for pol in REMAT_POLICIES:
+        remat_wrap(lambda x: x, pol)  # every policy constructs
+        if pol != "none":
+            remat_policy_fn(pol)
+    with pytest.raises(ValueError):
+        remat_policy_fn("bogus")
+    assert set(SELECTIVE_SAVE_NAMES) <= set(CHECKPOINT_NAMES)
+    assert "mlp_act" in CHECKPOINT_NAMES
+    assert "mlp_act" not in SELECTIVE_SAVE_NAMES  # elementwise: recompute
+
+
+def test_named_savepoints_present_in_jaxpr():
+    """The tags exist at their definition sites: the traced loss contains
+    every save-point name (minus flash_lse, which only materializes under
+    the flash custom-VJP fwd rule)."""
+    cfg = _base_cfg(hidden_dropout=0.0)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    tokens = jnp.zeros((1, 64), jnp.int32)
+    jaxpr = str(jax.make_jaxpr(
+        lambda p: model.loss(p, tokens, tokens)
+    )(params))
+    for name in ("qkv_proj", "attn_ctx", "attn_dense", "mlp_pre_act",
+                 "mlp_act", "mlp_out"):
+        assert name in jaxpr, name

@@ -173,7 +173,11 @@ def main():
     for pp in (4, 8):
         base_temp, base_flops = measure_nonpipelined(pp, 8)
         rows = []
-        for remat in ("tick", "dots", "none"):
+        # the full named-savepoint ladder (models/remat.py): tick==full,
+        # dots==save_dots; selective/offload keep the named matmul outputs
+        # (offload in pinned host — on the CPU measurement host==device,
+        # so its temp column reads like selective's)
+        for remat in ("tick", "selective", "dots", "offload", "none"):
             temp, flops, _, _ = measure(pp, 8, remat=remat)
             rows.append((remat, temp, flops * 8))
         floor = min(t for _, _, t in rows)
