@@ -8,3 +8,8 @@ from megatron_llm_tpu.inference.generation import (  # noqa: F401
     score_tokens,
 )
 from megatron_llm_tpu.inference.sampling import sample  # noqa: F401
+from megatron_llm_tpu.inference.engine import (  # noqa: F401
+    DecodeEngine,
+    EngineRequest,
+    QueueFull,
+)

@@ -9,13 +9,17 @@ mesh.
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional
 
 import jax
 import numpy as np
 
+_logger = logging.getLogger(__name__)
+
 from megatron_llm_tpu.inference.generation import (
     beam_search,
+    bucket_prefill_len,
     generate_tokens,
     score_tokens,
 )
@@ -66,30 +70,45 @@ def _params_nbytes(params) -> int:
 
 def _pp_decode_fn(model, ctx, statics):
     key = (model, ctx.mesh, statics)
-    if key not in _PP_DECODE_CACHE:
-        # bound the executable cache: shape statics vary per request
-        # (max_len is 64-bucketed by the caller); FIFO-evict beyond 8
-        while len(_PP_DECODE_CACHE) >= 8:
-            _PP_DECODE_CACHE.pop(next(iter(_PP_DECODE_CACHE)))
-        from megatron_llm_tpu.config import ParallelConfig
-        from megatron_llm_tpu.parallel.pipeline import (
-            make_pipelined_decode_fn,
+    if key in _PP_DECODE_CACHE:
+        # LRU requeue: pop + reinsert moves the hit to the back of the
+        # dict's insertion order, so hot shapes survive churn
+        fn = _PP_DECODE_CACHE.pop(key)
+        _PP_DECODE_CACHE[key] = fn
+        return fn
+    # bound the executable cache: shape statics vary per request (max_len
+    # AND prefill_len are bucketed by the caller, so the key space is
+    # small but unbounded across traffic). Eviction is LEAST-RECENTLY-
+    # USED — the requeue above, then drop the front — capped at 8, and
+    # every eviction WARNS: the evicted shape's next request silently
+    # pays a full pipeline recompile, the #1 serving-latency footgun.
+    while len(_PP_DECODE_CACHE) >= 8:
+        old_key = next(iter(_PP_DECODE_CACHE))
+        _PP_DECODE_CACHE.pop(old_key)
+        _logger.warning(
+            "pp decode executable cache full (8): evicting LRU entry "
+            "with statics %s; the next request at that shape recompiles "
+            "the pipelined decode", old_key[2],
         )
+    from megatron_llm_tpu.config import ParallelConfig
+    from megatron_llm_tpu.parallel.pipeline import (
+        make_pipelined_decode_fn,
+    )
 
-        (prefill_len, max_len, greedy, top_k, top_p, temperature,
-         vocab_size, termination_id, use_eod_early,
-         return_log_probs) = statics
-        pcfg = ParallelConfig(pipeline_parallel_size=ctx.pp,
-                              tensor_parallel_size=ctx.tp,
-                              context_parallel_size=ctx.cp)
-        _PP_DECODE_CACHE[key] = jax.jit(make_pipelined_decode_fn(
-            model, pcfg, ctx, prefill_len=prefill_len, max_len=max_len,
-            greedy=greedy, top_k=top_k, top_p=top_p,
-            temperature=temperature, vocab_size=vocab_size,
-            termination_id=termination_id,
-            use_eod_for_early_termination=use_eod_early,
-            return_log_probs=return_log_probs,
-        ))
+    (prefill_len, max_len, greedy, top_k, top_p, temperature,
+     vocab_size, termination_id, use_eod_early,
+     return_log_probs) = statics
+    pcfg = ParallelConfig(pipeline_parallel_size=ctx.pp,
+                          tensor_parallel_size=ctx.tp,
+                          context_parallel_size=ctx.cp)
+    _PP_DECODE_CACHE[key] = jax.jit(make_pipelined_decode_fn(
+        model, pcfg, ctx, prefill_len=prefill_len, max_len=max_len,
+        greedy=greedy, top_k=top_k, top_p=top_p,
+        temperature=temperature, vocab_size=vocab_size,
+        termination_id=termination_id,
+        use_eod_for_early_termination=use_eod_early,
+        return_log_probs=return_log_probs,
+    ))
     return _PP_DECODE_CACHE[key]
 
 
@@ -251,10 +270,15 @@ def generate_and_post_process(
             seed = int.from_bytes(_os.urandom(4), "little")
         rng = jax.random.key(seed)
 
-    # prefill the longest common multiple-of-64 prefix; the rest of each
-    # prompt is teacher-forced by the decode loop (bounded compile shapes)
+    # prefill the longest common BUCKETED prefix; the rest of each prompt
+    # is teacher-forced by the decode loop. `prefill_len` is a jit static
+    # of generate_tokens (and of the pp decode statics below), so it must
+    # come from a bounded bucket set: multiples of 64, powers of two
+    # below 64 (bucket_prefill_len). Passing the raw min length minted
+    # one executable per distinct short-prompt length
+    # (tests/test_server.py::test_prefill_bucketing_bounds_executables).
     min_len = int(np.min(lengths))
-    prefill_len = max(1, (min_len // 64) * 64) if min_len >= 64 else min_len
+    prefill_len = bucket_prefill_len(min_len)
 
     if pp_pipelined:
         b, max_len = tokens.shape

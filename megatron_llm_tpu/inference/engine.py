@@ -1,0 +1,636 @@
+"""Continuous-batching decode engine on a paged KV cache (ISSUE 3).
+
+The whole-batch path (`generate_tokens`) is synchronous: every request
+in a call starts together, the batch runs until the SLOWEST row
+finishes, and each row owns a dense (b, g, max_len, d) cache sized to
+the worst case. Mixed-length traffic wastes both HBM and decode steps.
+This engine is the serving-side alternative, after Ragged Paged
+Attention (arxiv 2604.15464) and the slot-level-admission result of the
+Gemma-on-TPU serving study (arxiv 2605.25645):
+
+- the cache is a GLOBAL page pool per layer (num_pages, page_size, g,
+  d) plus one (slots, max_pages) page table and per-slot lengths
+  (models/gpt.py init_paged_kv_caches); HBM holds `page_budget` tokens
+  of KV total, not slots * max_len;
+- a fixed number of SLOTS decode in lockstep through a jitted
+  lax.scan of up to `step_horizon` single-token steps per host
+  round-trip (dispatch amortizer; the horizon is clamped to the
+  nearest slot completion and pow2-bucketed, so at most
+  log2(H)+1 scan lengths x {greedy, mixed} are ever traced) —
+  admission, retirement and ragged lengths never recompile anything;
+- finished slots retire their pages to a free list and queued requests
+  are admitted mid-flight into the free slots: a bucketed prefill
+  (`bucket_prefill_len` compile shapes) writes the prompt's K/V
+  straight into the slot's pages, then the slot joins the next step;
+- per-request knobs (tokens_to_generate, greedy/top-k/top-p/
+  temperature/seed, logprobs) ride per-slot ARRAYS through the step
+  function — they are data, not compile-time statics.
+
+Greedy decode is exact-match with `generate_tokens` for the same
+prompt (tests/test_engine.py): the engine splits prefill at the same
+bucket and teacher-forces the remainder, so every position sees the
+identical op sequence; the paged XLA fallback gathers pages into the
+same dense view the dense path reads.
+
+Scheduling is host-driven (one device scan per loop iteration) because
+admission IS a host decision; the dense engine's while_loop stays the
+right tool for single-shot batch eval (docs/GUIDE.md, "when the dense
+kernel still wins").
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.inference.generation import bucket_prefill_len
+from megatron_llm_tpu.inference.sampling import (
+    NEG_INF,
+    modify_logits_for_top_p,
+)
+
+_logger = logging.getLogger(__name__)
+
+
+class QueueFull(RuntimeError):
+    """Raised by submit() when the admission queue is at capacity; the
+    HTTP layer maps it to 503 + Retry-After."""
+
+
+def _per_slot_sample(logits, greedy, temperature, top_k, top_p, seeds,
+                     steps, vocab_size):
+    """One sampling decision per SLOT with per-slot knobs as traced
+    arrays (the whole-batch `sample` takes them as jit statics — a
+    continuous batch mixes them freely, so they must be data here).
+    top-k/top-p reproduce inference/sampling.py semantics, including the
+    top-p shift-by-1, via one shared descending sort; greedy rows ignore
+    the sampled value. RNG: per-request seed folded with the request's
+    own sampling-step count, so a request's stream is independent of
+    which slot it landed in and of its neighbours."""
+    V = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    if vocab_size is not None and vocab_size < V:
+        pad = jnp.arange(V) >= vocab_size
+        logits = jnp.where(pad[None, :], NEG_INF, logits)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    l = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # per-row top-k: the kth DESCENDING-sorted value is the row's
+    # threshold (modify_logits_for_top_k needs a static k; the threshold
+    # form is its per-row generalization)
+    sorted_l = jnp.sort(l, axis=-1)[:, ::-1]
+    kth_idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_l, kth_idx[:, None], axis=-1)
+    l = jnp.where((top_k > 1)[:, None] & (l < kth), NEG_INF, l)
+    # per-row top-p through the ONE reference implementation
+    # (sampling.modify_logits_for_top_p broadcasts a (rows, 1)
+    # threshold); rows with top_p == 0 keep their logits untouched
+    filt = modify_logits_for_top_p(l, top_p[:, None])
+    l = jnp.where((top_p > 0.0)[:, None], filt, l)
+
+    keys = jax.vmap(
+        lambda s, t: jax.random.fold_in(jax.random.key(s), t)
+    )(seeds, steps)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row)
+    )(keys, l).astype(jnp.int32)
+    return jnp.where(greedy, greedy_tok, sampled)
+
+
+@dataclass
+class EngineRequest:
+    """One queued/running generation. `tokens` grows to prompt +
+    generated; `log_probs[i]` (when requested) is
+    log P(tokens[i+1] | tokens[:i+1]) — the generate_tokens layout."""
+
+    rid: int
+    prompt: List[int]
+    tokens_to_generate: int
+    greedy: bool = True
+    top_k: int = 0
+    top_p: float = 0.0
+    temperature: float = 1.0
+    seed: int = 0
+    return_log_probs: bool = False
+    use_eod_for_early_termination: bool = True
+
+    tokens: List[int] = field(default_factory=list)
+    log_probs: List[float] = field(default_factory=list)
+    error: Optional[str] = None
+    done: threading.Event = field(default_factory=threading.Event)
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_done: float = 0.0
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the request finishes; returns (tokens, log_probs)."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.rid} still running")
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return self.tokens, (self.log_probs if self.return_log_probs
+                             else None)
+
+
+@dataclass
+class _Slot:
+    req: Optional[EngineRequest] = None
+    pages: List[int] = field(default_factory=list)
+    forced: collections.deque = field(default_factory=collections.deque)
+    generated: int = 0
+    sample_step: int = 0
+
+
+def _make_step_fn(model, vocab_size, horizon, all_greedy):
+    """The jitted continuous-batching step, traced once per (engine,
+    horizon bucket): a lax.scan of `horizon` single-token steps — each
+    samples/teacher-forces one token per slot from the carried logits
+    and runs it through the paged stack (scatter K/V into each slot's
+    current page, paged attention over owned pages). Batching HORIZON
+    steps per host round-trip amortizes dispatch latency (on the axon
+    tunnel one dispatch can cost more than the step itself); the host
+    clamps the horizon to the nearest slot completion, so no request
+    ever overruns its budget inside a horizon. Page pools are donated —
+    the update is in place."""
+
+    def step(dec_params, pools_k, pools_v, page_table, lengths,
+             last_logits, active, forced, use_forced, greedy, temperature,
+             top_k, top_p, seeds, sample_steps):
+        # forced/use_forced: (slots, horizon) — the remaining prompt
+        # tokens are known in advance, so teacher forcing rides the scan
+
+        def body(carry, xs):
+            pools_k, pools_v, lengths, last_logits, steps_c = carry
+            forced_t, use_forced_t = xs
+            lp_full = jax.nn.log_softmax(
+                last_logits.astype(jnp.float32), axis=-1)
+            if all_greedy:
+                # every live request is greedy (the serving-bench hot
+                # path): the per-row sort/cumsum machinery of the
+                # sampled branch would cost a full (slots, V) sort per
+                # token for nothing — argmax on the clamped logits is
+                # the entire decision
+                l = last_logits.astype(jnp.float32)
+                if vocab_size is not None and vocab_size < l.shape[-1]:
+                    pad = jnp.arange(l.shape[-1]) >= vocab_size
+                    l = jnp.where(pad[None, :], NEG_INF, l)
+                sampled = jnp.argmax(l, axis=-1).astype(jnp.int32)
+            else:
+                sampled = _per_slot_sample(
+                    last_logits, greedy, temperature, top_k, top_p,
+                    seeds, steps_c, vocab_size)
+            chosen = jnp.where(use_forced_t, forced_t, sampled)
+            chosen = jnp.where(active, chosen, 0)
+            chosen_lp = jnp.take_along_axis(
+                lp_full, chosen[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            caches = {"k_pages_layers": pools_k,
+                      "v_pages_layers": pools_v,
+                      "page_table": page_table, "lengths": lengths}
+            logits, new_caches = model.forward(
+                dec_params, chosen[:, None], kv_caches=caches,
+                position_ids=lengths[:, None],
+            )
+            steps_c = steps_c + (active & ~use_forced_t)
+            return ((new_caches["k_pages_layers"],
+                     new_caches["v_pages_layers"],
+                     new_caches["lengths"], logits[:, 0], steps_c),
+                    (chosen, chosen_lp))
+
+        carry = (pools_k, pools_v, lengths, last_logits, sample_steps)
+        carry, (chosen_h, lp_h) = jax.lax.scan(
+            body, carry, (forced.T, use_forced.T))
+        pools_k, pools_v, _, last_logits, _ = carry
+        # (horizon, slots) -> (slots, horizon)
+        return (chosen_h.T, lp_h.T, last_logits, pools_k, pools_v)
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def _make_prefill_fn(model, prefill_len, page_size):
+    """Bucketed prefill, traced once per bucket: one causal forward over
+    the prompt's bucket prefix through dense per-layer caches, whose
+    K/V rows are scattered STRAIGHT into the slot's pool pages inside
+    the same jitted program (XLA fuses the relayout with the cache
+    write). Returns updated pools, the slot's next-token logits, and
+    the prompt logprobs of the prefix."""
+
+    def prefill(dec_params, pools_k, pools_v, tokens, pt_row):
+        caches = model.init_kv_caches(1, prefill_len, layout="layers")
+        logits, caches = model.forward(dec_params, tokens,
+                                       kv_caches=caches)
+        lp = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+        prompt_lp = jnp.take_along_axis(
+            lp[:-1], tokens[0, 1:, None].astype(jnp.int32), axis=-1)[:, 0]
+        pos = jnp.arange(prefill_len)
+        pages = pt_row[pos // page_size]
+        offs = pos % page_size
+        pools_k = tuple(
+            pk.at[pages, offs].set(kl[0].transpose(1, 0, 2))
+            for pk, kl in zip(pools_k, caches["k_layers"]))
+        pools_v = tuple(
+            pv.at[pages, offs].set(vl[0].transpose(1, 0, 2))
+            for pv, vl in zip(pools_v, caches["v_layers"]))
+        return pools_k, pools_v, logits[0, -1], prompt_lp
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
+class DecodeEngine:
+    """Fixed-slot continuous-batching decode engine over a paged pool.
+
+    Knobs (docs/GUIDE.md "Continuous-batching serving engine"):
+    - `slots`: concurrent requests decoding per step; the step batch.
+    - `page_size`: tokens per KV page (>= 16 to keep the Pallas kernel
+      eligible; 64 default balances fragmentation vs table size).
+    - `page_budget`: total KV positions in the pool across all slots
+      (+1 null page is added internally). Defaults to the full
+      reservation slots * max_context — set it lower to oversubscribe
+      HBM against observed context lengths; admission then blocks on
+      free pages, never preempts.
+    - `max_context`: per-slot prompt + generation cap; fixes the page
+      table width (static for the step trace).
+    - `max_queue`: admission queue depth; submit() past it raises
+      QueueFull (the HTTP layer's 503).
+    - `step_horizon`: decode steps per host round-trip (one jitted
+      scan) — amortizes dispatch latency at the price of quantizing
+      admission/retirement latency; clamped per call to the nearest
+      slot completion so no budget is overrun mid-scan.
+
+    Pages are reserved UP FRONT at admission for the request's whole
+    prompt + tokens_to_generate reach, so a running request can never
+    be starved mid-flight (no preemption path to get wrong); the
+    trade is documented in the guide.
+    """
+
+    def __init__(self, model, params, *, slots: int = 4,
+                 page_size: int = 64, max_context: int = 1024,
+                 page_budget: Optional[int] = None, max_queue: int = 64,
+                 step_horizon: int = 8,
+                 termination_id: Optional[int] = None,
+                 vocab_size: Optional[int] = None, timers=None):
+        assert max_context % page_size == 0, \
+            "max_context must be a multiple of page_size"
+        self.model = model
+        self.cfg = model.cfg
+        self.slots = slots
+        self.page_size = page_size
+        self.max_pages_per_slot = max_context // page_size
+        self.max_context = max_context
+        if page_budget is None:
+            page_budget = slots * max_context
+        assert page_budget % page_size == 0
+        self.num_pages = 1 + page_budget // page_size  # +1: null page 0
+        self.max_queue = max_queue
+        # decode steps per host round-trip: dispatch latency amortizer
+        # (admission/retirement latency is quantized by it; the host
+        # clamps each call to the nearest slot completion so no budget
+        # is overrun, and buckets the clamp to powers of two so at most
+        # log2(step_horizon)+1 scan lengths are ever traced)
+        self.step_horizon = max(1, step_horizon)
+        self.termination_id = termination_id
+        self.vocab_size = vocab_size
+        self.timers = timers
+
+        self._dec_params = (model.prepare_decode_params(params)
+                            if hasattr(model, "prepare_decode_params")
+                            else params)
+        caches = model.init_paged_kv_caches(
+            slots, self.num_pages, page_size, self.max_pages_per_slot)
+        self._pools_k = caches["k_pages_layers"]
+        self._pools_v = caches["v_pages_layers"]
+        V = self.cfg.padded_vocab_size
+        self._last_logits = jnp.zeros((slots, V), jnp.float32)
+        # host-authoritative mirrors (tiny; shipped to device each step)
+        self._pt = np.zeros((slots, self.max_pages_per_slot), np.int32)
+        self._lengths = np.zeros((slots,), np.int32)
+        self._free_pages = list(range(self.num_pages - 1, 0, -1))
+
+        self._slots = [_Slot() for _ in range(slots)]
+        self._queue: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._next_rid = 0
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._broken: Optional[str] = None
+
+        self._step_fns: dict = {}  # horizon bucket -> jitted scan
+        self._prefill_fns: dict = {}
+
+        # counters (exported through the timers-gauge path)
+        self._admitted = 0
+        self._retired = 0
+        self._steps = 0
+        self._tokens_out = 0
+        self._t0 = time.perf_counter()
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, prompt: List[int], tokens_to_generate: int, *,
+               top_k: int = 1, top_p: float = 0.0,
+               temperature: float = 1.0, seed: int = 0,
+               return_log_probs: bool = False,
+               use_eod_for_early_termination: bool = True
+               ) -> EngineRequest:
+        """Queue one request. Raises ValueError when it cannot ever fit
+        (prompt + generation past max_context) and QueueFull when the
+        queue is at capacity — callers map the latter to 503."""
+        total = len(prompt) + tokens_to_generate
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if tokens_to_generate < 1:
+            raise ValueError("tokens_to_generate must be >= 1 (score-only "
+                             "requests take the whole-batch path)")
+        if total > self.max_context:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + tokens_to_generate "
+                f"({tokens_to_generate}) exceeds the engine max_context "
+                f"({self.max_context})")
+        # must also fit the POOL: under an oversubscribed page_budget a
+        # request can satisfy max_context yet need more pages than the
+        # pool holds — admitted, it would sit at the FIFO head forever
+        # and starve everything behind it
+        need = -(-total // self.page_size)
+        if need > self.num_pages - 1:
+            raise ValueError(
+                f"request needs {need} pages but the pool holds only "
+                f"{self.num_pages - 1} (page_budget "
+                f"{(self.num_pages - 1) * self.page_size} tokens); raise "
+                f"page_budget or shrink the request")
+        if self._broken is not None:
+            raise RuntimeError(f"engine is stopped: {self._broken}")
+        req = EngineRequest(
+            rid=-1, prompt=list(prompt),
+            tokens_to_generate=tokens_to_generate,
+            greedy=(top_k == 1), top_k=top_k, top_p=top_p,
+            temperature=temperature, seed=seed,
+            return_log_probs=return_log_probs,
+            use_eod_for_early_termination=use_eod_for_early_termination,
+        )
+        req.t_submit = time.perf_counter()
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                raise QueueFull(
+                    f"engine queue at capacity ({self.max_queue})")
+            req.rid = self._next_rid
+            self._next_rid += 1
+            self._queue.append(req)
+            self._work.notify()
+        return req
+
+    def _prefill_fn(self, plen):
+        if plen not in self._prefill_fns:
+            self._prefill_fns[plen] = _make_prefill_fn(
+                self.model, plen, self.page_size)
+        return self._prefill_fns[plen]
+
+    def _admit(self):
+        """Move queued requests into free slots while pages allow.
+        FIFO head-of-line: a request that does not fit blocks the ones
+        behind it (predictable latency ordering, no starvation)."""
+        for si, slot in enumerate(self._slots):
+            if slot.req is not None:
+                continue
+            with self._lock:
+                if not self._queue:
+                    return
+                req = self._queue[0]
+                need = -(-(len(req.prompt) + req.tokens_to_generate)
+                         // self.page_size)
+                if len(self._free_pages) < need:
+                    return
+                self._queue.popleft()
+                # claim the slot INSIDE the lock: stop(drain=True) polls
+                # "queue empty and no slot busy" — a request must never
+                # be invisible to that check between dequeue and prefill
+                slot.req = req
+            pages = [self._free_pages.pop() for _ in range(need)]
+            self._pt[si] = 0
+            self._pt[si, :need] = pages
+            plen = bucket_prefill_len(len(req.prompt))
+            self._pools_k, self._pools_v, row_logits, plp = \
+                self._prefill_fn(plen)(
+                    self._dec_params, self._pools_k, self._pools_v,
+                    jnp.asarray(np.asarray(req.prompt[:plen],
+                                           np.int32)[None]),
+                    jnp.asarray(self._pt[si]),
+                )
+            self._last_logits = self._last_logits.at[si].set(row_logits)
+            self._lengths[si] = plen
+            slot.pages = pages
+            slot.forced = collections.deque(req.prompt[plen:])
+            slot.generated = 0
+            slot.sample_step = 0
+            req.tokens = list(req.prompt)
+            if req.return_log_probs:
+                req.log_probs = [float(x) for x in np.asarray(plp)]
+            req.t_admit = time.perf_counter()
+            self._admitted += 1
+
+    def _retire(self, si: int):
+        slot = self._slots[si]
+        self._free_pages.extend(slot.pages)
+        slot.pages = []
+        self._pt[si] = 0
+        self._lengths[si] = 0
+        req = slot.req
+        slot.req = None
+        req.t_done = time.perf_counter()
+        self._retired += 1
+        req.done.set()
+
+    # -- the decode loop ---------------------------------------------------
+
+    def _step_fn(self, horizon, all_greedy):
+        key = (horizon, all_greedy)
+        if key not in self._step_fns:
+            self._step_fns[key] = _make_step_fn(
+                self.model, self.vocab_size, horizon, all_greedy)
+        return self._step_fns[key]
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, run ONE jitted scan of up to
+        `step_horizon` decode steps over every live slot, book tokens,
+        retire finished. The horizon is clamped to the nearest slot
+        completion (so no request overruns its budget mid-scan) and
+        bucketed to a power of two (bounded trace count). Returns False
+        when there was nothing to do (idle)."""
+        self._admit()
+        live = [i for i, s in enumerate(self._slots) if s.req is not None]
+        if not live:
+            return False
+        # nearest completion: forced tokens still owed + sampling budget
+        remaining = min(
+            len(self._slots[i].forced) + self._slots[i].req
+            .tokens_to_generate - self._slots[i].generated
+            for i in live)
+        hor = min(self.step_horizon, max(remaining, 1))
+        hor = 1 << (hor.bit_length() - 1)  # pow2 bucket
+
+        n = self.slots
+        active = np.zeros(n, bool)
+        forced = np.zeros((n, hor), np.int32)
+        use_forced = np.zeros((n, hor), bool)
+        greedy = np.ones(n, bool)
+        temperature = np.ones(n, np.float32)
+        top_k = np.zeros(n, np.int32)
+        top_p = np.zeros(n, np.float32)
+        seeds = np.zeros(n, np.uint32)
+        sample_steps = np.zeros(n, np.int32)
+        for i in live:
+            s = self._slots[i]
+            r = s.req
+            active[i] = True
+            nf = min(len(s.forced), hor)
+            if nf:
+                forced[i, :nf] = [s.forced[t] for t in range(nf)]
+                use_forced[i, :nf] = True
+            greedy[i] = r.greedy
+            temperature[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            seeds[i] = np.uint32(r.seed & 0xFFFFFFFF)
+            sample_steps[i] = s.sample_step
+
+        all_greedy = all(self._slots[i].req.greedy for i in live)
+        (chosen, chosen_lp, new_logits, self._pools_k, self._pools_v) = \
+            self._step_fn(hor, all_greedy)(
+                self._dec_params, self._pools_k, self._pools_v,
+                jnp.asarray(self._pt), jnp.asarray(self._lengths),
+                self._last_logits, jnp.asarray(active),
+                jnp.asarray(forced), jnp.asarray(use_forced),
+                jnp.asarray(greedy), jnp.asarray(temperature),
+                jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(seeds), jnp.asarray(sample_steps),
+            )
+        self._last_logits = new_logits
+        chosen = np.asarray(chosen)  # (slots, hor)
+        chosen_lp = np.asarray(chosen_lp)
+        self._steps += hor
+
+        for t in range(hor):
+            for i in live:
+                s = self._slots[i]
+                r = s.req
+                if r is None:
+                    continue  # retired earlier in this horizon (eod)
+                self._lengths[i] += 1
+                if r.return_log_probs:
+                    r.log_probs.append(float(chosen_lp[i, t]))
+                if s.forced:
+                    s.forced.popleft()  # prompt token, already in tokens
+                    continue
+                tok = int(chosen[i, t])
+                r.tokens.append(tok)
+                s.generated += 1
+                s.sample_step += 1
+                self._tokens_out += 1
+                hit_eod = (r.use_eod_for_early_termination
+                           and self.termination_id is not None
+                           and tok == self.termination_id)
+                if hit_eod or s.generated >= r.tokens_to_generate:
+                    self._retire(i)
+        return True
+
+    def drain(self):
+        """Run until the queue and every slot are empty."""
+        while self.step():
+            pass
+
+    # -- background serve loop --------------------------------------------
+
+    def _fail_all(self, msg: str):
+        """Fail every queued and in-flight request (fatal step error or
+        non-drain stop) so no waiter hangs on a dead engine."""
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            req.error = msg
+            req.done.set()
+        for i, s in enumerate(self._slots):
+            if s.req is not None:
+                s.req.error = msg
+                self._retire(i)
+
+    def start(self):
+        assert self._thread is None, "engine already started"
+        self._running = True
+
+        def loop():
+            while self._running:
+                try:
+                    did = self.step()
+                except Exception as e:  # noqa: BLE001 — a dead serve
+                    # loop with hung waiters is strictly worse than any
+                    # error it could swallow: fail every request LOUDLY
+                    # and refuse new ones
+                    self._broken = f"engine step failed: {e!r}"
+                    _logger.exception("serve loop died; failing all "
+                                      "in-flight requests")
+                    self._fail_all(self._broken)
+                    self._running = False
+                    return
+                if not did:
+                    with self._work:
+                        if self._running:
+                            self._work.wait(timeout=0.05)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True):
+        """Stop the serve loop; drain=True (graceful) finishes every
+        admitted AND queued request first, drain=False fails queued
+        requests and abandons running slots."""
+        if self._thread is None:
+            return
+        if drain:
+            while self._thread.is_alive() and self._broken is None:
+                with self._lock:
+                    busy = bool(self._queue) or any(
+                        s.req is not None for s in self._slots)
+                if not busy:
+                    break
+                time.sleep(0.005)
+        self._running = False
+        with self._work:
+            self._work.notify_all()
+        self._thread.join()
+        self._thread = None
+        if not drain:
+            self._fail_all("engine stopped")
+
+    # -- observability -----------------------------------------------------
+
+    def counters(self) -> dict:
+        """Live serving counters; exported via `export_gauges` through
+        the existing timers-gauge path (training/timers.py)."""
+        occupied = sum(1 for s in self._slots if s.req is not None)
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        return {
+            "serve_slot_occupancy": occupied / self.slots,
+            "serve_queue_depth": len(self._queue),
+            "serve_pages_in_use": self.num_pages - 1
+            - len(self._free_pages),
+            "serve_pages_free": len(self._free_pages),
+            "serve_admitted": self._admitted,
+            "serve_retired": self._retired,
+            "serve_steps": self._steps,
+            "serve_tok_s": round(self._tokens_out / dt, 2),
+        }
+
+    def export_gauges(self, timers=None):
+        timers = timers if timers is not None else self.timers
+        if timers is None:
+            return
+        for name, value in self.counters().items():
+            timers.gauge(name, value)

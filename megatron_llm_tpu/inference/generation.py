@@ -46,6 +46,20 @@ class GenerateOutput(NamedTuple):
     log_probs: Optional[jnp.ndarray]  # (b, max_len - 1) fp32 or None
 
 
+def bucket_prefill_len(min_len: int) -> int:
+    """Bucket a prompt's prefill length DOWN to a bounded set of compile
+    shapes: multiples of 64 at >= 64, powers of two below (1,2,4,...,32).
+    `prefill_len` is a jit static arg of `generate_tokens` (and of the
+    serving engine's prefill), so every distinct value is a distinct
+    compiled executable — raw short-prompt lengths were minting up to 63
+    of them (ISSUE 3 satellite). Bucketing DOWN is always safe: the
+    positions past the bucket are teacher-forced by the decode loop, so
+    tokens/logprobs are unchanged."""
+    if min_len >= 64:
+        return (min_len // 64) * 64
+    return 1 << (max(min_len, 1).bit_length() - 1)
+
+
 def select_next_token(
     logits,  # (b, V) fp32-castable
     prev_token,  # (b,) int32

@@ -4,8 +4,20 @@ Parity target: ref megatron/text_generation_server.py — `MegatronGenerate`
 (PUT /api, :17-233, including every request-validation message) and
 `MegatronServer` (:234-241). The reference needs flask_restful plus a
 broadcast to wake the non-rank-0 GPU cohort (:22-29); the JAX build is
-single-controller, so a stdlib ThreadingHTTPServer with a generation lock
-replaces both (flask isn't in the image; the HTTP surface is identical).
+single-controller, so a stdlib ThreadingHTTPServer replaces both (flask
+isn't in the image; the HTTP surface is identical).
+
+Dispatch (ISSUE 3): with a `DecodeEngine` attached, generate requests
+are ENQUEUED — each prompt becomes one engine request carrying its own
+tokens_to_generate / sampling knobs, admitted mid-flight into free
+slots, so concurrent PUTs batch together instead of serializing. A full
+queue returns 503 + Retry-After. Score-only, beam and the knobs the
+engine does not speak (prevent_newline_after_colon, top_p_decay) take
+the whole-batch path under a NON-BLOCKING device lock: a second
+concurrent request gets 503 + Retry-After instead of stacking device
+work behind a blocked thread (two unlocked concurrent PUTs used to race
+on the same device; stacking them hid the overload from the client).
+`MegatronServer.stop()` drains the engine before returning.
 """
 
 from __future__ import annotations
@@ -23,15 +35,18 @@ from megatron_llm_tpu.inference.api import (
 GENERATE_NUM = 0
 BEAM_NUM = 1
 LOCK = threading.Lock()
+BUSY_MSG = "server is busy processing another request"
+QUEUE_FULL_MSG = "generation queue is full"
 
 
 class MegatronGenerate:
     """Request validation + dispatch (ref: MegatronGenerate :17-233)."""
 
-    def __init__(self, model, params, tokenizer):
+    def __init__(self, model, params, tokenizer, engine=None):
         self.model = model
         self.params = params
         self.tokenizer = tokenizer
+        self.engine = engine
 
     def put(self, raw: dict):
         """Returns (payload, http_status); validation messages mirror the
@@ -99,13 +114,38 @@ class MegatronGenerate:
         stop_token = raw.get("stop_token", None)
         length_penalty = raw.get("length_penalty", 1.0)
 
-        with LOCK:  # one generation at a time (ref :186)
+        if beam_width is not None:
+            if not isinstance(beam_width, int) or beam_width < 1:
+                return "beam_width must be integer > 0", 400
+            if len(prompts) > 1:
+                return "When doing beam_search, batch size must be 1", 400
+
+        # continuous-batching dispatch: everything the engine speaks goes
+        # through its queue (per-request knobs, slot-level admission); the
+        # engine-ineligible residue (score-only, beam, pnac/top_p_decay)
+        # keeps the whole-batch path below
+        if (self.engine is not None and beam_width is None
+                and tokens_to_generate > 0
+                and not prevent_newline_after_colon
+                and top_p_decay == 0.0):
+            resp = self._put_engine(
+                prompts, tokens_to_generate, logprobs, top_k, top_p,
+                temperature, add_BOS, random_seed,
+            )
+            if resp is not None:
+                return resp
+            # None: the request exceeds the engine's max_context/pool —
+            # a capability the whole-batch path still has; fall through
+
+        # one whole-batch generation at a time (ref :186) — but NON-
+        # blocking: a concurrent request is overload, and the honest
+        # answer is 503 + Retry-After, not device work stacking up
+        # behind a blocked handler thread
+        if not LOCK.acquire(blocking=False):
+            return {"message": BUSY_MSG}, 503
+        try:
             try:
                 if beam_width is not None:
-                    if not isinstance(beam_width, int) or beam_width < 1:
-                        return "beam_width must be integer > 0", 400
-                    if len(prompts) > 1:
-                        return "When doing beam_search, batch size must be 1", 400
                     texts, segments, scores, _ = beam_search_and_post_process(
                         self.model, self.params, self.tokenizer, prompts,
                         tokens_to_generate=tokens_to_generate,
@@ -143,6 +183,78 @@ class MegatronGenerate:
                 }, 200
             except Exception as e:  # ref returns jsonified error (:230)
                 return {"message": repr(e)}, 500
+        finally:
+            LOCK.release()
+
+    def _put_engine(self, prompts, tokens_to_generate, logprobs, top_k,
+                    top_p, temperature, add_BOS, random_seed):
+        """Queue each prompt as one engine request and wait for all of
+        them; the response shape matches the whole-batch path (ragged
+        logprobs: one list per prompt). Returns None — caller falls back
+        to the whole-batch path — when any prompt exceeds the engine's
+        max_context or page pool (those limits don't exist there)."""
+        import numpy as np
+
+        from megatron_llm_tpu.inference.engine import QueueFull
+        from megatron_llm_tpu.inference.tokenization import (
+            detokenize_generations,
+        )
+
+        tok = self.tokenizer
+        prompt_ids = []
+        for p in prompts:
+            ids = tok.tokenize(p)
+            if add_BOS:
+                ids = [tok.bos] + ids
+            prompt_ids.append(ids)
+        eng = self.engine
+        pool_tokens = (eng.num_pages - 1) * eng.page_size
+        if any(len(ids) + tokens_to_generate
+               > min(eng.max_context, pool_tokens)
+               for ids in prompt_ids):
+            return None
+        reqs = []
+        try:
+            for i, ids in enumerate(prompt_ids):
+                if random_seed >= 0:
+                    seed = random_seed + i  # decorrelate rows, keep
+                    # request-level determinism (engine RNG is per
+                    # request, not per batch position)
+                else:
+                    import os as _os
+
+                    seed = int.from_bytes(_os.urandom(4), "little")
+                try:
+                    reqs.append(self.engine.submit(
+                        ids, tokens_to_generate,
+                        top_k=top_k, top_p=top_p, temperature=temperature,
+                        seed=seed, return_log_probs=logprobs,
+                        use_eod_for_early_termination=True,
+                    ))
+                except QueueFull:
+                    # admitted prefixes of THIS PUT still complete; the
+                    # client retries the whole request after Retry-After
+                    return {"message": QUEUE_FULL_MSG}, 503
+            rows, lps = [], []
+            for r in reqs:
+                toks, lp = r.result(timeout=600.0)
+                rows.append(toks)
+                lps.append(lp)
+            max_len = max(len(t) for t in rows)
+            buf = np.full((len(rows), max_len), tok.eod, np.int32)
+            for i, t in enumerate(rows):
+                buf[i, : len(t)] = t
+            lengths = np.asarray([len(t) for t in rows], np.int32)
+            texts, segments = detokenize_generations(
+                tok, buf, lengths, return_segments=True)
+            return {
+                "text": texts,
+                "segments": segments,
+                "logprobs": ([list(map(float, l)) for l in lps]
+                             if logprobs else None),
+            }, 200
+        except Exception as e:  # same jsonified-error contract (:230)
+            return {"message": repr(e)}, 500
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -183,6 +295,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        if status == 503:
+            # overload (busy device / full queue): tell clients when to
+            # come back instead of letting them hammer the socket
+            self.send_header("Retry-After", "1")
         self.end_headers()
         self.wfile.write(data)
 
@@ -191,14 +307,21 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class MegatronServer:
-    """ref: MegatronServer (text_generation_server.py:234-241)."""
+    """ref: MegatronServer (text_generation_server.py:234-241). Pass a
+    `DecodeEngine` (inference/engine.py) to serve generate requests
+    through the continuous-batching queue; its serve loop is started by
+    `run` and gracefully drained by `stop`."""
 
-    def __init__(self, model, params, tokenizer):
-        self.generator = MegatronGenerate(model, params, tokenizer)
+    def __init__(self, model, params, tokenizer, engine=None):
+        self.engine = engine
+        self.generator = MegatronGenerate(model, params, tokenizer,
+                                          engine=engine)
         self._httpd = None
 
     def run(self, host: str = "0.0.0.0", port: int = 5000,
             block: bool = True):
+        if self.engine is not None and self.engine._thread is None:
+            self.engine.start()
         handler = type("Handler", (_Handler,), {"generator": self.generator})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         if block:
@@ -210,6 +333,10 @@ class MegatronServer:
         return self._httpd
 
     def stop(self):
+        """Stop accepting requests, then DRAIN the engine: every
+        admitted and queued request finishes before this returns."""
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
+        if self.engine is not None:
+            self.engine.stop(drain=True)

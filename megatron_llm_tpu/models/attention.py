@@ -228,12 +228,18 @@ def attention_block(
     -> output proj (ref: ParallelAttention.forward transformer.py:412-537).
 
     `kv_cache` for incremental decode (ref: InferenceParams
-    forward_step.py:17, transformer.py:483-496), two forms:
+    forward_step.py:17, transformer.py:483-496), three forms:
     - stacked (the decode hot path, what transformer_stack passes):
       {"k": (L, b, maxT, g, d), "v": ..., "offset": scalar, "layer": idx}
       — this layer's token column is updated IN PLACE inside the stack;
     - per-layer {"k": (b, maxT, g, d), "v": ..., "offset": scalar} for
-      standalone single-layer use.
+      standalone single-layer use;
+    - paged (the continuous-batching engine, inference/engine.py):
+      {"k_pages": (P, page_size, g, d), "v_pages": ..., "page_table":
+      (slots, max_pages) int32, "lengths": (slots,) int32} — the batch
+      axis is SLOTS at ragged per-slot lengths; this step's token K/V is
+      scattered into each slot's current page and attention streams only
+      the pages a slot owns (ops/decode_attention.paged_decode_attention).
     """
     b, s, h = hidden.shape
     compute_dtype = cfg.compute_dtype
@@ -248,6 +254,59 @@ def attention_block(
     q, k, v = split_qkv(mixed, cfg)
     q = shard_activation(q, "groups")
 
+    if kv_cache is not None and "k_pages" in kv_cache:
+        # paged decode step (s == 1): slot i's token sits at position
+        # lengths[i]; its K/V lands in pool page
+        # page_table[i, lengths[i] // page_size]. Retired/empty slots
+        # carry an all-null page-table row (engine contract), so their
+        # writes fall into the pool's null page 0 and never touch a live
+        # slot's cache.
+        assert s == 1, "paged KV cache serves single-token decode steps"
+        g, qpk, d = cfg.num_query_groups, cfg.q_per_kv, cfg.head_dim
+        lengths = kv_cache["lengths"]
+        page_table = kv_cache["page_table"]
+        if position_ids is None:
+            position_ids = lengths[:, None]
+        if rope_table is not None:
+            q = apply_rope(q, rope_table, position_ids)
+            k = apply_rope(k, rope_table, position_ids)
+        ps = kv_cache["k_pages"].shape[1]
+        pages = jnp.take_along_axis(
+            page_table, (lengths // ps)[:, None], axis=1)[:, 0]
+        offs = lengths % ps
+        kp = kv_cache["k_pages"].at[pages, offs].set(k[:, 0])
+        vp = kv_cache["v_pages"].at[pages, offs].set(v[:, 0])
+        new_cache = {"k_pages": kp, "v_pages": vp,
+                     "page_table": page_table, "lengths": lengths + 1}
+        from megatron_llm_tpu.ops.decode_attention import (
+            _xla_paged_decode,
+            paged_decode_attention,
+            paged_decode_attn_block,
+        )
+
+        bt = None
+        if cfg.use_decode_attn:
+            bt = paged_decode_attn_block(
+                s, qpk, d, ps, page_table.shape[1],
+                min_cache=cfg.decode_attn_min_cache,
+                interpret=cfg.decode_attn_interpret,
+            )
+        if bt is not None:
+            ctx = paged_decode_attention(
+                q, kp, vp, page_table, lengths + 1, use_pallas=True,
+                interpret=cfg.decode_attn_interpret,
+            )
+        else:
+            # the paged kernel's shapes-and-math twin (gather pages to
+            # the dense view + the _xla_decode op sequence) — ONE shared
+            # definition, same contract as the dense branches below
+            ctx = _xla_paged_decode(q, kp, vp, page_table, lengths + 1)
+        ctx = shard_activation(ctx.reshape(b, s, g, qpk * d), "heads") \
+            .reshape(b, s, -1)
+        out = ctx @ attn_params["wo"].astype(compute_dtype)
+        if "bo" in attn_params:
+            out = out + attn_params["bo"].astype(compute_dtype)
+        return out, new_cache
     if kv_cache is not None:
         offset = kv_cache["offset"]
         if position_ids is None:

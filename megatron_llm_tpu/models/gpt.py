@@ -146,3 +146,30 @@ class GPTModel:
             "v": jnp.zeros(shape, cfg.compute_dtype),
             "offset": jnp.array(0, jnp.int32),
         }
+
+    def init_paged_kv_caches(self, slots: int, num_pages: int,
+                             page_size: int,
+                             max_pages_per_slot: int) -> dict:
+        """Paged KV cache for the continuous-batching engine
+        (inference/engine.py): per-layer GLOBAL page pools
+        (num_pages, page_size, g, d) shared by all slots, one
+        (slots, max_pages_per_slot) page table mapping each slot's
+        logical pages to pool indices, and per-slot valid lengths.
+        Pool page 0 is the NULL page (never allocated): fresh/retired
+        slots point every table entry at it, so clamped kernel DMAs and
+        inactive-slot writes always land on a real — but dead — page.
+        HBM cost per layer: 2 * num_pages * page_size * g * d *
+        itemsize; unlike the dense layouts above it is independent of
+        slots * max_len, which is the whole point (docs/GUIDE.md,
+        "Continuous-batching serving engine")."""
+        cfg = self.cfg
+        shape = (num_pages, page_size, cfg.num_query_groups, cfg.head_dim)
+        return {
+            "k_pages_layers": tuple(jnp.zeros(shape, cfg.compute_dtype)
+                                    for _ in range(cfg.num_layers)),
+            "v_pages_layers": tuple(jnp.zeros(shape, cfg.compute_dtype)
+                                    for _ in range(cfg.num_layers)),
+            "page_table": jnp.zeros((slots, max_pages_per_slot),
+                                    jnp.int32),
+            "lengths": jnp.zeros((slots,), jnp.int32),
+        }

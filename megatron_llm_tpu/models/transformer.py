@@ -288,8 +288,31 @@ def transformer_stack(
         # weights AND cache out of stacked buffers each token — a full
         # extra read+write of the weights and cache per step (traced on
         # v5e); standalone buffers are read in place.
-        assert kv_caches is not None and "k_layers" in kv_caches, \
-            "unrolled (tuple) layer params are the decode fast path"
+        assert kv_caches is not None and (
+            "k_layers" in kv_caches or "k_pages_layers" in kv_caches
+        ), "unrolled (tuple) layer params are the decode fast path"
+        if "k_pages_layers" in kv_caches:
+            # paged decode (continuous-batching engine): per-layer page
+            # POOLS with one shared page table + per-slot lengths; each
+            # layer scatters its token column into the slot's current
+            # page and reads back only owned pages (attention_block's
+            # paged branch). Same unrolled structure as the dense decode
+            # fast path — standalone per-layer buffers, no stack slicing.
+            pt = kv_caches["page_table"]
+            lens = kv_caches["lengths"]
+            ks = list(kv_caches["k_pages_layers"])
+            vs = list(kv_caches["v_pages_layers"])
+            for i in range(L):
+                cache_l = {"k_pages": ks[i], "v_pages": vs[i],
+                           "page_table": pt, "lengths": lens}
+                (hidden,), nc = body(
+                    (hidden,), (layer_params[i], idxs[i], cache_l)
+                )
+                ks[i], vs[i] = nc["k_pages"], nc["v_pages"]
+            return hidden, {
+                "k_pages_layers": tuple(ks), "v_pages_layers": tuple(vs),
+                "page_table": pt, "lengths": lens + hidden.shape[1],
+            }
         offset = kv_caches["offset"]
         ks = list(kv_caches["k_layers"])
         vs = list(kv_caches["v_layers"])

@@ -31,6 +31,25 @@ blocks in training, with decode-specific structure:
 to `_xla_decode`, a numerically matching reference, elsewhere.
 `decode_attn_block` is the static viability check the model layer gates
 on; it returns the chosen cache block size or None (XLA fallback).
+
+PAGED VARIANT (ISSUE 3 tentpole, after Ragged Paged Attention — arxiv
+2604.15464): `paged_decode_attention` serves the continuous-batching
+engine (inference/engine.py). The cache is a GLOBAL page pool
+(num_pages, page_size, g, d) shared by every slot; each slot owns a row
+of a (slots, max_pages) page table plus a per-slot valid length. The
+kernel is the same exp2 online softmax with two changes: the valid
+length is read per grid row (`lengths[slot]`, not one shared scalar),
+and the K/V block index map dereferences the scalar-prefetched page
+table — grid step (slot, group, j) DMAs pool page
+`page_table[slot, j]`, with past-the-length steps clamped to the slot's
+last valid page so Mosaic elides the repeated DMA. Cache traffic
+follows each slot's CURRENT length; slots at different lengths coexist
+in one launch with zero padding traffic between them. Page 0 of the
+pool is the NULL page by convention: unowned page-table entries point
+at it and retired/inactive slots park there, so clamped DMAs always
+have a real page to read. `_xla_paged_decode` (gather pages to the
+dense "tgd" view, then the `_xla_decode` math) is the numerically
+matching fallback and the CPU test oracle.
 """
 
 from __future__ import annotations
@@ -99,12 +118,14 @@ def decode_attn_block(s: int, qpk: int, d: int, T: int, *,
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
                    acc_scr, *, block_t, rows, qpk, d, num_t_blocks,
-                   sm_scale, s, split_boundary=True):
+                   sm_scale, s, split_boundary=True, batched_len=False):
     """Grid (b, g, num_t_blocks); the t dim carries the online-softmax
     state in VMEM scratch. Row r of the folded (rows, d) q block is query
-    position offset + r // qpk (head fastest), offset = length - s."""
+    position offset + r // qpk (head fastest), offset = length - s.
+    `batched_len` reads a PER-ROW length (the paged engine's ragged
+    slots) instead of the dense path's one shared scalar."""
     j = pl.program_id(2)
-    length = len_ref[0]
+    length = len_ref[pl.program_id(0)] if batched_len else len_ref[0]
     offset = length - s
 
     @pl.when(j == 0)
@@ -301,3 +322,164 @@ def decode_attention(
         if bt is not None:
             return _decode_pallas(q, k, v, length, layout, bt, interpret)
     return _xla_decode(q, k, v, length, layout)
+
+
+# ---------------------------------------------------------------------------
+# Paged variant: global page pool + per-slot page table (the
+# continuous-batching serving cache, inference/engine.py)
+# ---------------------------------------------------------------------------
+
+
+def paged_decode_attn_block(s: int, qpk: int, d: int, page_size: int,
+                            num_slot_pages: int, *,
+                            min_cache: int = 0,
+                            interpret: bool = False) -> Optional[int]:
+    """Static dispatch check for the paged kernel: returns the block size
+    (== page_size; the page IS the DMA unit) or None for the XLA path.
+
+    Same territory as `decode_attn_block` — single-token steps,
+    lane-aligned head dim, a big-enough cache — with the block constraint
+    moved onto the page: `page_size` must tile sublanes (multiple of 16
+    covers bf16), and the per-slot reach num_slot_pages * page_size
+    stands in for the allocated T of the dense gate.
+    """
+    if not (interpret or jax.default_backend() == "tpu"):
+        return None
+    if s != 1 or s * qpk > MAX_DECODE_ROWS or d % 128 != 0:
+        return None
+    if page_size < 16 or page_size % 16 != 0:
+        return None
+    if num_slot_pages * page_size < max(min_cache, 16):
+        return None
+    return page_size
+
+
+def _paged_pallas(q, k_pages, v_pages, page_table, lengths, interpret):
+    """q: (slots, 1, g, qpk, d); k/v_pages: (num_pages, page_size, g, d);
+    page_table: (slots, max_pages) int32 pool indices; lengths: (slots,)
+    int32 valid positions per slot (0 = empty slot -> zero output).
+    Returns (slots, 1, g, qpk, d) in q's dtype."""
+    b, s, g, qpk, d = q.shape
+    assert s == 1, "paged decode is single-token by construction"
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    rows = qpk
+
+    qf = q.transpose(0, 2, 1, 3, 4).reshape(b, g, rows, d)
+    # same Mosaic small-memref workaround as the dense launcher: rows
+    # below one fp32 sublane tile launch q/o in fp32
+    out_dtype = q.dtype if rows % 8 == 0 else jnp.float32
+    qf = qf.astype(out_dtype)
+
+    body = functools.partial(
+        _decode_kernel, block_t=page_size, rows=rows, qpk=qpk, d=d,
+        num_t_blocks=max_pages, sm_scale=1.0 / (d ** 0.5), s=1,
+        split_boundary=not interpret, batched_len=True,
+    )
+
+    def kernel(len_ref, pt_ref, *rest):
+        # the page table is consumed entirely by the index maps; the
+        # online-softmax body is the dense kernel's, fed per-slot lengths
+        body(len_ref, *rest)
+
+    def page_index(ib, j, len_ref, pt_ref):
+        # past-the-length grid steps re-read the slot's LAST valid page
+        # (repeated index -> elided DMA); empty slots (length 0) clamp to
+        # table entry 0, which points at the pool's null page.
+        last = jnp.maximum(len_ref[ib] - 1, 0) // page_size
+        return pt_ref[ib, jnp.minimum(j, last)]
+
+    q_spec = pl.BlockSpec(
+        (None, None, rows, d),
+        lambda ib, ig, j, len_ref, pt_ref: (ib, ig, 0, 0),
+    )
+    kv_spec = pl.BlockSpec(
+        (None, page_size, None, d),
+        lambda ib, ig, j, len_ref, pt_ref: (
+            page_index(ib, j, len_ref, pt_ref), 0, ig, 0
+        ),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, g, max_pages),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((b, g, rows, d), out_dtype, qf, k_pages,
+                              v_pages),
+        compiler_params=None if interpret else _compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(lengths, jnp.int32), jnp.asarray(page_table, jnp.int32),
+      qf, k_pages, v_pages)
+    return out.reshape(b, g, 1, qpk, d).transpose(0, 2, 1, 3, 4) \
+        .astype(q.dtype)
+
+
+def _xla_paged_decode(q, k_pages, v_pages, page_table, lengths):
+    """Gather the owned pages into the dense (b, g, T, d) view, then the
+    exact `_xla_decode` op sequence with per-row lengths — the
+    shapes-and-math twin of the paged kernel, used off-TPU and by the
+    engine's exact-match tests. Zero-probability columns (masked past
+    each slot's length) multiply whatever the unwritten pool pages hold
+    by an exact fp 0, so the gathered width never leaks into values."""
+    b, s, g, qpk, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    T = max_pages * page_size
+    k = k_pages[page_table].reshape(b, T, g, d).transpose(0, 2, 1, 3)
+    v = v_pages[page_table].reshape(b, T, g, d).transpose(0, 2, 1, 3)
+    qb = q.transpose(0, 2, 1, 3, 4).reshape(b, g, s * qpk, d)
+    scores = jax.lax.dot_general(
+        qb, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ) * (1.0 / jnp.sqrt(d).astype(jnp.float32))  # (b, g, s*qpk, T)
+    row_pos = (lengths - s)[:, None] + jnp.arange(s * qpk)[None, :] // qpk
+    mask = jnp.arange(T)[None, None, :] > row_pos[:, :, None]
+    scores = jnp.where(mask[:, None], jnp.finfo(jnp.float32).min, scores)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jax.lax.dot_general(
+        probs, v, (((3,), (2,)), ((0, 1), (0, 1))),
+    )  # (b, g, s*qpk, d)
+    # empty slots (length 0, every column masked): the softmax above
+    # degenerates to uniform-over-garbage; pin them to the kernel's
+    # exact-zero output so both paths share one contract
+    out = jnp.where((lengths > 0)[:, None, None, None], out,
+                    jnp.zeros((), out.dtype))
+    return out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # (slots, 1, g, qpk, d)
+    k_pages: jnp.ndarray,  # (num_pages, page_size, g, d)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # (slots, max_pages) int32 pool indices
+    lengths: jnp.ndarray,  # (slots,) int32 valid positions incl. this step
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged paged decode attention: slot i attends its query token to
+    cache positions 0..lengths[i]-1, streamed page-by-page from the pool
+    through its page-table row. Positions past lengths[i] are masked
+    in-kernel; a slot with lengths[i] == 0 returns zeros."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        b, s, g, qpk, d = q.shape
+        bt = paged_decode_attn_block(
+            s, qpk, d, k_pages.shape[1], page_table.shape[1],
+            interpret=interpret,
+        )
+        if bt is not None:
+            return _paged_pallas(q, k_pages, v_pages, page_table, lengths,
+                                 interpret)
+    return _xla_paged_decode(q, k_pages, v_pages, page_table, lengths)

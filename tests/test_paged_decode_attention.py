@@ -1,0 +1,287 @@
+"""Paged decode-attention correctness (ISSUE 3 tentpole, kernel layer).
+
+All kernel runs go through the REAL Pallas kernel via the interpreter on
+CPU (same pattern as tests/test_decode_attention.py). Pinned here:
+
+- paged kernel vs the gather-then-dense XLA reference across per-slot
+  lengths that start, straddle and end pages (partial last pages), for
+  MHA/GQA/MQA and bf16;
+- paged vs the DENSE decode reference on the gathered view: the page
+  indirection must be invisible to the math;
+- empty slots (length 0) return exact zeros on both paths;
+- the static dispatch gate (page-size tiling, lane alignment, s==1,
+  min-cache threshold, backend/interpret);
+- attention_block's paged branch: kernel on vs XLA fallback parity, and
+  the page-table-directed scatter of the step's K/V (null-page routing
+  for retired slots).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.ops.decode_attention import (
+    _xla_decode,
+    _xla_paged_decode,
+    paged_decode_attention,
+    paged_decode_attn_block,
+)
+
+
+def _pool_case(slots, g, qpk, d, page_size, pages_per_slot,
+               dtype=jnp.float32, seed=0):
+    """Random pool + a page table whose rows use distinct, shuffled
+    pages (page 0 reserved as null)."""
+    num_pages = 1 + slots * pages_per_slot
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (slots, 1, g, qpk, d), dtype)
+    kp = jax.random.normal(ks[1], (num_pages, page_size, g, d), dtype)
+    vp = jax.random.normal(ks[2], (num_pages, page_size, g, d), dtype)
+    rs = np.random.RandomState(seed)
+    perm = rs.permutation(num_pages - 1) + 1  # never the null page
+    pt = jnp.asarray(perm.reshape(slots, pages_per_slot), jnp.int32)
+    return q, kp, vp, pt
+
+
+CASES = [
+    pytest.param(4, 1, id="mha"),
+    pytest.param(2, 2, id="gqa"),
+    pytest.param(1, 8, id="mqa"),
+]
+
+
+class TestPagedKernel:
+    @pytest.mark.parametrize("g,qpk", CASES)
+    def test_matches_xla_across_ragged_lengths(self, g, qpk):
+        """Per-slot lengths at page starts, page ends, and mid-page
+        (partial last page) in ONE launch must each agree with the
+        gathered-dense reference."""
+        q, kp, vp, pt = _pool_case(3, g, qpk, 128, 16, 4)
+        for lengths in ([1, 17, 64], [16, 32, 33], [15, 48, 31],
+                        [64, 1, 63]):
+            lengths = jnp.asarray(lengths, jnp.int32)
+            out = paged_decode_attention(q, kp, vp, pt, lengths,
+                                         use_pallas=True, interpret=True)
+            ref = _xla_paged_decode(q, kp, vp, pt, lengths)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5,
+                err_msg=str(lengths),
+            )
+
+    def test_matches_dense_reference_per_slot(self):
+        """Gathering a slot's pages into the dense 'tgd' cache and
+        running the DENSE decode math must reproduce the paged output:
+        the page indirection is pure data movement."""
+        slots, g, qpk, d, ps, mp = 3, 2, 2, 128, 16, 4
+        q, kp, vp, pt = _pool_case(slots, g, qpk, d, ps, mp, seed=1)
+        lengths = jnp.asarray([5, 33, 64], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, pt, lengths,
+                                     use_pallas=True, interpret=True)
+        kd = kp[pt].reshape(slots, mp * ps, g, d)
+        vd = vp[pt].reshape(slots, mp * ps, g, d)
+        for i in range(slots):
+            ref = _xla_decode(q[i:i + 1], kd[i:i + 1], vd[i:i + 1],
+                              lengths[i], "tgd")
+            np.testing.assert_allclose(
+                np.asarray(out[i:i + 1]), np.asarray(ref),
+                rtol=1e-5, atol=1e-5, err_msg=f"slot {i}",
+            )
+
+    def test_empty_slot_returns_zeros(self):
+        q, kp, vp, pt = _pool_case(2, 2, 1, 128, 16, 2, seed=2)
+        lengths = jnp.asarray([0, 7], jnp.int32)
+        for use_pallas in (True, False):
+            out = paged_decode_attention(q, kp, vp, pt, lengths,
+                                         use_pallas=use_pallas,
+                                         interpret=True)
+            assert not np.any(np.asarray(out[0]))
+            assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_bf16_close(self):
+        q, kp, vp, pt = _pool_case(2, 2, 2, 128, 16, 2,
+                                   dtype=jnp.bfloat16, seed=3)
+        lengths = jnp.asarray([9, 25], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, pt, lengths,
+                                     use_pallas=True, interpret=True)
+        ref = _xla_paged_decode(q, kp, vp, pt, lengths)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+    def test_traced_table_and_lengths_under_jit(self):
+        """Page table and lengths are TRACED in the engine's step fn;
+        the scalar-prefetch operands must accept them."""
+        q, kp, vp, pt = _pool_case(2, 2, 1, 128, 16, 2, seed=4)
+
+        @jax.jit
+        def f(q, kp, vp, pt, lengths):
+            return paged_decode_attention(q, kp, vp, pt, lengths,
+                                          use_pallas=True, interpret=True)
+
+        for lengths in ([1, 32], [17, 2]):
+            lengths = jnp.asarray(lengths, jnp.int32)
+            np.testing.assert_allclose(
+                np.asarray(f(q, kp, vp, pt, lengths)),
+                np.asarray(_xla_paged_decode(q, kp, vp, pt, lengths)),
+                rtol=1e-5, atol=1e-5,
+            )
+
+
+class TestPagedDispatch:
+    def test_gate(self):
+        ok = dict(interpret=True)
+        assert paged_decode_attn_block(1, 1, 128, 64, 8, **ok) == 64
+        assert paged_decode_attn_block(1, 1, 128, 16, 8, **ok) == 16
+        # prefill chunks keep the GEMM path
+        assert paged_decode_attn_block(2, 1, 128, 64, 8, **ok) is None
+        # lane alignment
+        assert paged_decode_attn_block(1, 1, 64, 64, 8, **ok) is None
+        # page must tile sublanes
+        assert paged_decode_attn_block(1, 1, 128, 8, 8, **ok) is None
+        assert paged_decode_attn_block(1, 1, 128, 24, 8, **ok) is None
+        # min-cache threshold measured against the per-slot reach
+        assert paged_decode_attn_block(1, 1, 128, 16, 4, min_cache=128,
+                                       interpret=True) is None
+        assert paged_decode_attn_block(1, 1, 128, 16, 8, min_cache=128,
+                                       interpret=True) == 16
+        if jax.default_backend() != "tpu":
+            assert paged_decode_attn_block(1, 1, 128, 64, 8,
+                                           interpret=False) is None
+
+    def test_ineligible_shape_falls_back(self):
+        """page_size below the sublane tile refuses the kernel inside
+        the dispatcher and still answers via the XLA path."""
+        slots, g, qpk, d, ps, mp = 2, 2, 1, 128, 8, 4
+        q, kp, vp, pt = _pool_case(slots, g, qpk, d, ps, mp, seed=5)
+        lengths = jnp.asarray([3, 20], jnp.int32)
+        out = paged_decode_attention(q, kp, vp, pt, lengths,
+                                     use_pallas=True, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(out),
+            np.asarray(_xla_paged_decode(q, kp, vp, pt, lengths)),
+        )
+
+
+class TestAttentionBlockPaged:
+    """attention_block's paged branch: kernel vs XLA parity at the
+    layer-output level, page-table-directed K/V scatter, and null-page
+    routing for retired slots."""
+
+    def _cfg(self, **over):
+        from megatron_llm_tpu.config import ModelConfig
+
+        base = dict(
+            num_layers=1, hidden_size=256, num_attention_heads=2,
+            num_attention_heads_kv=1, kv_channels=128,
+            max_position_embeddings=64, seq_length=64,
+            compute_dtype=jnp.float32, params_dtype=jnp.float32,
+            use_bias=False, attention_dropout=0.0, hidden_dropout=0.0,
+            use_decode_attn=True, decode_attn_interpret=True,
+            decode_attn_min_cache=0,
+        )
+        base.update(over)
+        return ModelConfig(**base)
+
+    def _params(self, cfg, seed=0):
+        ks = jax.random.split(jax.random.key(seed), 2)
+        h = cfg.hidden_size
+        return {
+            "wqkv": jax.random.normal(
+                ks[0], (h, cfg.qkv_projection_size), jnp.float32) * 0.05,
+            "wo": jax.random.normal(
+                ks[1],
+                (cfg.num_attention_heads * cfg.head_dim, h),
+                jnp.float32) * 0.05,
+        }
+
+    def _cache(self, cfg, slots, ps, mp, lengths, seed=6):
+        g, d = cfg.num_query_groups, cfg.head_dim
+        num_pages = 1 + slots * mp
+        ks = jax.random.split(jax.random.key(seed), 2)
+        pt = np.zeros((slots, mp), np.int32)
+        nxt = 1
+        for i in range(slots):
+            pt[i] = np.arange(nxt, nxt + mp)
+            nxt += mp
+        return {
+            "k_pages": jax.random.normal(
+                ks[0], (num_pages, ps, g, d), jnp.float32),
+            "v_pages": jax.random.normal(
+                ks[1], (num_pages, ps, g, d), jnp.float32),
+            "page_table": jnp.asarray(pt),
+            "lengths": jnp.asarray(lengths, jnp.int32),
+        }
+
+    def test_kernel_vs_xla_paths(self):
+        from megatron_llm_tpu.models.attention import attention_block
+
+        cfg_on = self._cfg()
+        cfg_off = dataclasses.replace(cfg_on, use_decode_attn=False)
+        params = self._params(cfg_on)
+        slots, ps, mp = 2, 16, 4
+        hidden = jax.random.normal(jax.random.key(5), (slots, 1, 256),
+                                   jnp.float32)
+        out_on, cache_on = attention_block(
+            params, cfg_on, hidden, None, None, None,
+            kv_cache=self._cache(cfg_on, slots, ps, mp, [7, 33]))
+        out_off, cache_off = attention_block(
+            params, cfg_off, hidden, None, None, None,
+            kv_cache=self._cache(cfg_off, slots, ps, mp, [7, 33]))
+        np.testing.assert_allclose(
+            np.asarray(out_on), np.asarray(out_off), rtol=1e-5, atol=1e-6)
+        for key in cache_on:
+            np.testing.assert_array_equal(np.asarray(cache_on[key]),
+                                          np.asarray(cache_off[key]))
+
+    def test_scatter_targets_owned_page(self):
+        """The step's K/V lands at page_table[slot, len // ps] offset
+        len % ps, and ONLY there; lengths advance by one."""
+        from megatron_llm_tpu.models.attention import attention_block
+
+        cfg = self._cfg(use_decode_attn=False)
+        params = self._params(cfg)
+        slots, ps, mp = 2, 16, 4
+        cache = self._cache(cfg, slots, ps, mp, [7, 33])
+        before_k = np.asarray(cache["k_pages"]).copy()
+        hidden = jax.random.normal(jax.random.key(8), (slots, 1, 256),
+                                   jnp.float32)
+        _, new_cache = attention_block(
+            params, cfg, hidden, None, None, None, kv_cache=cache)
+        after_k = np.asarray(new_cache["k_pages"])
+        np.testing.assert_array_equal(np.asarray(new_cache["lengths"]),
+                                      [8, 34])
+        pt = np.asarray(cache["page_table"])
+        changed = np.argwhere(
+            np.any(after_k != before_k, axis=(2, 3)))  # (page, off) pairs
+        expect = {(int(pt[0, 7 // ps]), 7 % ps),
+                  (int(pt[1, 33 // ps]), 33 % ps)}
+        assert {tuple(map(int, rc)) for rc in changed} == expect
+
+    def test_retired_slot_writes_null_page(self):
+        """A slot with an all-zero page-table row (the engine's retired
+        state) scatters into pool page 0 and corrupts nothing else."""
+        from megatron_llm_tpu.models.attention import attention_block
+
+        cfg = self._cfg(use_decode_attn=False)
+        params = self._params(cfg)
+        slots, ps, mp = 2, 16, 2
+        cache = self._cache(cfg, slots, ps, mp, [5, 0])
+        pt = np.array(cache["page_table"])
+        pt[1] = 0  # slot 1 retired
+        cache["page_table"] = jnp.asarray(pt)
+        before_k = np.asarray(cache["k_pages"]).copy()
+        hidden = jax.random.normal(jax.random.key(9), (slots, 1, 256),
+                                   jnp.float32)
+        _, new_cache = attention_block(
+            params, cfg, hidden, None, None, None, kv_cache=cache)
+        after_k = np.asarray(new_cache["k_pages"])
+        changed_pages = set(
+            int(p) for p in
+            np.argwhere(np.any(after_k != before_k, axis=(1, 2, 3)))[:, 0]
+        )
+        assert changed_pages <= {0, int(pt[0, 5 // ps])}

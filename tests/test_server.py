@@ -1,0 +1,432 @@
+"""HTTP serving layer (ISSUE 3 satellite): validation byte-parity, the
+continuous-batching dispatch, concurrency, and overload behavior.
+
+Four layers pinned:
+- every request-validation error message, byte for byte against the
+  reference server's strings (ref: text_generation_server.py:39-99) —
+  these need no model, so they run in tier-1;
+- a real end-to-end generate over HTTP THROUGH THE ENGINE (tiny model),
+  including per-request knobs and logprobs;
+- concurrent requests: engine-path PUTs batch and all succeed;
+  whole-batch-path PUTs (no engine) get an honest 503 + Retry-After
+  instead of stacking behind the device lock;
+- queue-full: submit past max_queue -> 503 with Retry-After;
+- the prefill_len bucketing regression: distinct short prompt lengths
+  share one compiled decode executable (ISSUE 3 satellite).
+"""
+
+import json
+import threading
+import time
+from http.client import HTTPConnection
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.inference.server import (
+    BUSY_MSG,
+    QUEUE_FULL_MSG,
+    MegatronGenerate,
+    MegatronServer,
+)
+
+
+class ByteTokenizer:
+    vocab_size = 256
+    eod = 0
+    bos = 1
+
+    def tokenize(self, text):
+        return [b % 256 for b in text.encode()]
+
+    def detokenize(self, ids):
+        return bytes(int(i) % 256 for i in ids).decode(errors="replace")
+
+
+class _NoModel:
+    """Validation happens before any model touch; fail loudly if not."""
+
+    def __getattr__(self, name):
+        raise AssertionError("validation must not touch the model")
+
+
+# ---------------------------------------------------------------------------
+# Validation byte-parity (tier-1: no model, no device)
+# ---------------------------------------------------------------------------
+
+
+VALIDATION_CASES = [
+    ({}, "prompts argument required"),
+    ({"prompts": ["a"], "max_len": 4},
+     "max_len is no longer used.  Replace with tokens_to_generate"),
+    ({"prompts": ["a"], "sentences": ["a"]},
+     "sentences is no longer used.  Replace with prompts"),
+    ({"prompts": "a"}, "prompts is not a list of strings"),
+    ({"prompts": []}, "prompts is empty"),
+    ({"prompts": ["a"] * 129}, "Maximum number of prompts is 128"),
+    ({"prompts": ["a"], "tokens_to_generate": "x"},
+     "tokens_to_generate must be an integer greater than 0"),
+    ({"prompts": ["a"], "tokens_to_generate": -1},
+     "tokens_to_generate must be an integer greater than or equal to 0"),
+    ({"prompts": ["a"], "logprobs": "yes"},
+     "logprobs must be a boolean value"),
+    ({"prompts": ["a"], "tokens_to_generate": 0},
+     "tokens_to_generate=0 implies logprobs should be True"),
+    ({"prompts": ["a"], "temperature": 0.0},
+     "temperature must be a positive number less than or equal to 100.0"),
+    ({"prompts": ["a"], "temperature": 101.0},
+     "temperature must be a positive number less than or equal to 100.0"),
+    ({"prompts": ["a"], "top_k": 1001},
+     "top_k must be an integer equal to or greater than 0 and less than "
+     "or equal to 1000"),
+    ({"prompts": ["a"], "top_p": 1.5},
+     "top_p must be less than or equal to 1 and greater than or equal "
+     "to 0"),
+    ({"prompts": ["a"], "top_k": 2, "top_p": 0.5},
+     "cannot set both top-k and top-p samplings."),
+    ({"prompts": ["a"], "add_BOS": "yes"},
+     "add_BOS must be a boolean value"),
+    ({"prompts": [""]}, "Empty prompts require add_BOS=true"),
+    ({"prompts": ["a"], "beam_width": 0},
+     "beam_width must be integer > 0"),
+    ({"prompts": ["a", "b"], "beam_width": 2},
+     "When doing beam_search, batch size must be 1"),
+]
+
+
+@pytest.mark.parametrize(
+    "payload,message",
+    VALIDATION_CASES,
+    ids=[m[:40].replace(" ", "_") for _, m in VALIDATION_CASES],
+)
+def test_validation_messages_byte_parity(payload, message):
+    gen = MegatronGenerate(_NoModel(), None, ByteTokenizer())
+    got, status = gen.put(payload)
+    assert status == 400
+    assert got == message
+
+
+def test_queue_full_returns_503(tiny_engine_stub=None):
+    """An engine whose queue is at capacity answers 503 with the
+    queue-full message — without touching the model (the stub engine
+    raises QueueFull on submit, exactly like a saturated real one)."""
+    from megatron_llm_tpu.inference.engine import QueueFull
+
+    class FullEngine:
+        max_context = 1024
+        num_pages = 17
+        page_size = 64
+
+        def submit(self, *a, **k):
+            raise QueueFull("full")
+
+    gen = MegatronGenerate(_NoModel(), None, ByteTokenizer(),
+                           engine=FullEngine())
+    got, status = gen.put({"prompts": ["ab"], "tokens_to_generate": 2})
+    assert status == 503
+    assert got == {"message": QUEUE_FULL_MSG}
+
+
+def test_engine_overflow_prompt_falls_back_to_whole_batch():
+    """A prompt past the engine's max_context is a capability the
+    whole-batch path still has: the server must fall back to it (under
+    the lock), not 500 out of engine.submit."""
+    import megatron_llm_tpu.inference.server as srv
+
+    class TinyEngine:
+        max_context = 8
+        num_pages = 3
+        page_size = 4
+
+        def submit(self, *a, **k):
+            raise AssertionError("oversize prompt must not reach submit")
+
+    calls = []
+
+    def fake_generate(*a, **k):
+        calls.append(a)
+        return ["long...!"], [["l"]], None, np.zeros((1, 3), np.int32)
+
+    gen = MegatronGenerate(_NoModel(), None, ByteTokenizer(),
+                           engine=TinyEngine())
+    orig = srv.generate_and_post_process
+    srv.generate_and_post_process = fake_generate
+    try:
+        got, status = gen.put({"prompts": ["x" * 32],
+                               "tokens_to_generate": 4})
+        assert status == 200 and got["text"] == ["long...!"]
+        assert calls, "must have fallen back to the whole-batch path"
+    finally:
+        srv.generate_and_post_process = orig
+
+
+def test_busy_lock_returns_503():
+    """Two concurrent whole-batch PUTs (no engine): the second gets an
+    immediate 503 instead of stacking behind the device lock."""
+    import megatron_llm_tpu.inference.server as srv
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_generate(*a, **k):
+        entered.set()
+        assert release.wait(10)
+        return ["ab!"], [["a", "b", "!"]], None, np.zeros((1, 3), np.int32)
+
+    gen = MegatronGenerate(_NoModel(), None, ByteTokenizer())
+    orig = srv.generate_and_post_process
+    srv.generate_and_post_process = slow_generate
+    try:
+        results = {}
+
+        def first():
+            results["first"] = gen.put(
+                {"prompts": ["ab"], "tokens_to_generate": 1})
+
+        t = threading.Thread(target=first)
+        t.start()
+        assert entered.wait(10)
+        got, status = gen.put({"prompts": ["cd"], "tokens_to_generate": 1})
+        assert status == 503 and got == {"message": BUSY_MSG}
+        release.set()
+        t.join()
+        assert results["first"][1] == 200
+    finally:
+        srv.generate_and_post_process = orig
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the engine (tiny model; slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served_engine():
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.config import tiny_config
+    from megatron_llm_tpu.inference.engine import DecodeEngine
+    from megatron_llm_tpu.models import LlamaModel
+
+    cfg = tiny_config(compute_dtype=jnp.float32, use_decode_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(7))
+    tok = ByteTokenizer()
+    engine = DecodeEngine(model, params, slots=2, page_size=16,
+                          max_context=64, max_queue=8,
+                          termination_id=tok.eod,
+                          vocab_size=tok.vocab_size)
+    srv = MegatronServer(model, params, tok, engine=engine)
+    srv.run("127.0.0.1", 0, block=False)
+    httpd = srv._httpd
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield model, params, tok, engine, port
+    httpd.shutdown()
+    engine.stop(drain=False)
+
+
+def _put(port, payload, timeout=300):
+    conn = HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("PUT", "/api", json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read().decode())
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, body, headers
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    def test_generate_through_engine_matches_whole_batch(
+            self, served_engine):
+        """Greedy HTTP generate through the engine equals the
+        whole-batch api path for the same prompt (ISSUE 3 acceptance at
+        the HTTP layer)."""
+        from megatron_llm_tpu.inference.api import (
+            generate_and_post_process,
+        )
+
+        model, params, tok, engine, port = served_engine
+        status, body, _ = _put(port, {
+            "prompts": ["hello"], "tokens_to_generate": 4, "top_k": 1,
+            "logprobs": True,
+        })
+        assert status == 200
+        ref_texts, ref_segments, ref_lp, _ = generate_and_post_process(
+            model, params, tok, ["hello"], tokens_to_generate=4,
+            top_k_sampling=1, return_output_log_probs=True,
+            use_eod_token_for_early_termination=True,
+        )
+        assert body["text"] == ref_texts
+        assert body["segments"] == ref_segments
+        n = len(body["logprobs"][0])
+        np.testing.assert_allclose(
+            np.asarray(body["logprobs"][0]),
+            np.asarray(ref_lp[0][:n]), atol=1e-5)
+
+    def test_concurrent_puts_batch_through_engine(self, served_engine):
+        """Concurrent engine-path PUTs ALL succeed (they share slots
+        mid-flight) and each equals its solo reference — the old
+        whole-batch server could only serialize or race these."""
+        model, params, tok, engine, port = served_engine
+        prompts = ["abc", "defgh", "ij", "klmnopq"]
+        results = [None] * len(prompts)
+
+        def worker(i):
+            results[i] = _put(port, {
+                "prompts": [prompts[i]], "tokens_to_generate": 3,
+                "top_k": 1,
+            })
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        solo = {}
+        for i, p in enumerate(prompts):
+            status, body, _ = results[i]
+            assert status == 200, body
+            if p not in solo:
+                solo[p] = _put(port, {
+                    "prompts": [p], "tokens_to_generate": 3, "top_k": 1,
+                })[1]["text"]
+            assert body["text"] == solo[p]
+
+    def test_per_request_knobs_ride_along(self, served_engine):
+        """Sampled request with seed: deterministic across resubmission
+        (engine RNG is per-request), tokens_to_generate honored."""
+        _, _, _, _, port = served_engine
+        payload = {"prompts": ["xy"], "tokens_to_generate": 5,
+                   "top_k": 5, "temperature": 1.3, "random_seed": 11}
+        s1, b1, _ = _put(port, payload)
+        s2, b2, _ = _put(port, payload)
+        assert s1 == s2 == 200
+        assert b1["text"] == b2["text"]
+        assert len(b1["segments"][0]) == len("xy") + 5
+
+    def test_queue_full_over_http_retry_after(self, served_engine):
+        """12 simultaneous long PUTs against 2 slots + an 8-deep queue:
+        the overflow gets 503 + Retry-After (queue-full message), the
+        admitted ones all finish. The engine never blocks a handler
+        thread on a full queue — overload is answered immediately."""
+        model, params, tok, engine, port = served_engine
+        stores = [[] for _ in range(12)]
+
+        def worker(store):
+            store.append(_put(port, {
+                "prompts": ["zz"], "tokens_to_generate": 40,
+            }))
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in stores]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results = [s[0] for s in stores]
+        ok = [r for r in results if r[0] == 200]
+        rejected = [r for r in results if r[0] == 503]
+        assert len(ok) + len(rejected) == 12
+        assert ok, "admitted requests must complete"
+        assert rejected, "12 submits into 2 slots + 8 queue must overflow"
+        for status, body, headers in rejected:
+            assert body == {"message": QUEUE_FULL_MSG}
+            assert headers.get("Retry-After") == "1"
+
+
+# ---------------------------------------------------------------------------
+# prefill_len bucketing regression (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_prefill_len_unit():
+    from megatron_llm_tpu.inference.generation import bucket_prefill_len
+
+    assert [bucket_prefill_len(n) for n in (1, 2, 3, 7, 17, 33, 63)] \
+        == [1, 2, 2, 4, 16, 32, 32]
+    assert bucket_prefill_len(64) == 64
+    assert bucket_prefill_len(100) == 64
+    assert bucket_prefill_len(131) == 128
+    # never exceeds the prompt, never below 1
+    for n in range(1, 200):
+        assert 1 <= bucket_prefill_len(n) <= n
+
+
+def test_pp_decode_cache_is_lru_and_warns_on_eviction(monkeypatch):
+    """ISSUE 3 satellite: the pp decode executable cache is real LRU
+    (hits requeue; a hot shape survives churn that would age it out of
+    a FIFO) and every eviction logs a loud warning — silent recompiles
+    are the #1 serving-latency footgun."""
+    import logging
+
+    import jax
+
+    import megatron_llm_tpu.inference.api as api
+    import megatron_llm_tpu.parallel.pipeline as pl
+
+    class FakeModel:
+        pass
+
+    class Ctx:
+        mesh = "m"
+        pp = 2
+        tp = 1
+        cp = 1
+
+    monkeypatch.setattr(pl, "make_pipelined_decode_fn",
+                        lambda *a, **k: (lambda *args: None))
+    monkeypatch.setattr(jax, "jit", lambda f, **k: f)
+    monkeypatch.setattr(api, "_PP_DECODE_CACHE", {})
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    logger = logging.getLogger("megatron_llm_tpu.inference.api")
+    logger.addHandler(handler)
+    try:
+        m, ctx = FakeModel(), Ctx()
+
+        def statics(i):
+            return (64, 128 + 64 * i, True, 1, 0.0, 1.0, 256, 0, True,
+                    False)
+
+        fns = [api._pp_decode_fn(m, ctx, statics(i)) for i in range(8)]
+        # a hit requeues: entry 0 becomes most-recent
+        assert api._pp_decode_fn(m, ctx, statics(0)) is fns[0]
+        assert not records
+        # 9th distinct shape evicts the LRU entry (1, NOT the hot 0)
+        api._pp_decode_fn(m, ctx, statics(8))
+        assert len(records) == 1 and "evicting LRU" in records[0]
+        assert api._pp_decode_fn(m, ctx, statics(0)) is fns[0]
+        assert len(records) == 1  # hits never warn
+        assert api._pp_decode_fn(m, ctx, statics(1)) is not fns[1]
+        assert len(records) == 2  # the recompile evicted another entry
+    finally:
+        logger.removeHandler(handler)
+
+
+@pytest.mark.slow
+def test_prefill_bucketing_bounds_executables(served_engine):
+    """Distinct short prompt min-lengths in the same bucket share ONE
+    compiled generate_tokens executable; pre-bucketing each length
+    minted its own (the regression this satellite fixes)."""
+    from megatron_llm_tpu.inference.api import generate_and_post_process
+    from megatron_llm_tpu.inference.generation import generate_tokens
+
+    model, params, tok, _, _ = served_engine
+    # 17/19/23 chars -> min lengths 17/19/23, all bucket to prefill 16;
+    # tokenize_prompts pads max_len to the same multiple of 64
+    prompts = [["q" * 17], ["r" * 19], ["s" * 23]]
+    generate_and_post_process(model, params, tok, prompts[0],
+                              tokens_to_generate=2, top_k_sampling=1)
+    before = generate_tokens._cache_size()
+    for p in prompts[1:]:
+        generate_and_post_process(model, params, tok, p,
+                                  tokens_to_generate=2, top_k_sampling=1)
+    assert generate_tokens._cache_size() == before, \
+        "same-bucket prompt lengths must not mint new executables"

@@ -30,6 +30,20 @@ def main():
     p.add_argument("--merge_file", default=None)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=5000)
+    # continuous-batching engine knobs (inference/engine.py; docs/GUIDE.md
+    # "Continuous-batching serving engine"). --serving_slots 0 disables
+    # the engine: every request takes the whole-batch path under the
+    # device lock (single-shot batch eval behavior).
+    p.add_argument("--serving_slots", type=int, default=8)
+    p.add_argument("--page_size", type=int, default=64)
+    p.add_argument("--max_context", type=int, default=2048)
+    p.add_argument("--page_budget", type=int, default=None,
+                   help="total pooled KV positions; default "
+                        "slots*max_context (full reservation)")
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--step_horizon", type=int, default=8,
+                   help="decode steps per host round-trip (dispatch "
+                        "amortizer; admission latency quantum)")
     args = p.parse_args()
 
     import jax
@@ -82,9 +96,25 @@ def main():
         args.tokenizer_type, vocab_file=args.vocab_file,
         merge_file=args.merge_file,
     )
+    engine = None
+    if args.serving_slots > 0:
+        from megatron_llm_tpu.inference.engine import DecodeEngine
+
+        engine = DecodeEngine(
+            model, params, slots=args.serving_slots,
+            page_size=args.page_size, max_context=args.max_context,
+            page_budget=args.page_budget, max_queue=args.max_queue,
+            step_horizon=args.step_horizon,
+            termination_id=tokenizer.eod,
+            vocab_size=tokenizer.vocab_size,
+        )
     print(f"serving {args.model} from {path} on "
-          f"http://{args.host}:{args.port}/api", flush=True)
-    MegatronServer(model, params, tokenizer).run(args.host, args.port)
+          f"http://{args.host}:{args.port}/api"
+          + (f" (continuous batching: {args.serving_slots} slots, "
+             f"{engine.num_pages - 1} pages x {args.page_size})"
+             if engine else " (whole-batch, no engine)"), flush=True)
+    MegatronServer(model, params, tokenizer, engine=engine).run(
+        args.host, args.port)
 
 
 if __name__ == "__main__":
