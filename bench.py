@@ -30,6 +30,15 @@ AND through the whole-batch path at the same concurrency —
 p50/p95 per-request latency for both paths, slot occupancy, and the
 measurement methodology stated in the row itself.
 
+Round-9 audit keys (ISSUE 4): `extra.serving.interference` measures
+long-prompt admission under load — short requests decoding while a
+max-length prompt arrives — on a CHUNKED engine (mixed prefill+decode
+rounds through the ragged paged prefill kernel,
+ops/prefill_attention.py) vs a WHOLE-PROMPT engine: TTFT p50/p95 and
+per-round decode-latency p95 for both, `chunked_vs_wholeprompt_ttft`
+as the headline ratio, per-round prefill-token maxima as the budget
+audit, methodology stated in-row.
+
 Methodology: the reference's in-repo anchor is the Llama-2-7B fine-tune at
 ~890 tokens/sec/GPU on A100-80GB (BASELINE.md; docs/guide/getting_started.md
 :195-201). A 7B model does not fit on the single 16GB v5e chip available
@@ -372,15 +381,106 @@ def serving_stats(model, params, workload, arrivals, *, slots=8,
     }
 
 
+def serving_interference_stats(model, params, *, slots=4, page_size=64,
+                               max_context=768, chunk=128,
+                               vocab_size=32000, n_short=8,
+                               short_prompt=32, short_gen=64,
+                               long_gen=16):
+    """TTFT + decode-latency interference during LONG-prompt admission,
+    chunked vs whole-prompt prefill on identical traffic. Methodology
+    (stated in the emitted row): `slots` short greedy requests are
+    decoding when a max-length prompt (max_context - long_gen tokens)
+    arrives, followed by a second wave of short requests; TTFT = submit
+    -> first GENERATED token; decode p95 = p95 wall ms per decode-token
+    advance per scheduler round (whole-prompt admission runs the full
+    prefill inside a round, so its stall lands in this gauge; chunked
+    rounds are budget-bounded by construction). Both engines are
+    compile-warmed off the clock; `chunked_vs_wholeprompt_ttft` > 1
+    means chunked admission cut p95 TTFT."""
+    import numpy as np
+
+    from megatron_llm_tpu.inference.engine import DecodeEngine
+
+    long_prompt_len = max_context - long_gen
+    rs = np.random.RandomState(0)
+    short_prompts = [list(rs.randint(2, vocab_size, short_prompt))
+                     for _ in range(n_short)]
+    long_prompt = list(rs.randint(2, vocab_size, long_prompt_len))
+    pct = DecodeEngine._pct  # the ONE percentile definition the gauges use
+
+    out = {}
+    for mode, chunk_toks in (("chunked", chunk), ("wholeprompt", 0)):
+        eng = DecodeEngine(
+            model, params, slots=slots, page_size=page_size,
+            max_context=max_context, max_queue=n_short + 1,
+            termination_id=None, vocab_size=vocab_size,
+            prefill_chunk_tokens=chunk_toks)
+        # compile-warm every executable this traffic reaches: both
+        # prompt shapes once through the engine, plus the scan/mixed
+        # bucket sweep
+        for p in (short_prompts[0], long_prompt):
+            eng.submit(p, 2, top_k=1)
+            eng.drain()
+        eng.warmup()
+        eng._ttft_ms.clear()
+        eng._decode_ms.clear()
+        eng._round_log.clear()
+
+        half = n_short // 2
+        first = [eng.submit(p, short_gen, top_k=1)
+                 for p in short_prompts[:half]]
+        while not all(r.t_first for r in first):  # get them decoding
+            eng.step()
+        long_req = eng.submit(long_prompt, long_gen, top_k=1)
+        rest = [eng.submit(p, short_gen, top_k=1)
+                for p in short_prompts[half:]]
+        eng.drain()
+        reqs = first + [long_req] + rest
+        ttfts = [(r.t_first - r.t_submit) * 1e3 for r in reqs]
+        out[mode] = {
+            "ttft_p50_ms": round(pct(ttfts, 0.50), 2),
+            "ttft_p95_ms": round(pct(ttfts, 0.95), 2),
+            "decode_p95_ms": round(pct(eng._decode_ms, 0.95), 2),
+            "max_round_prefill_tokens": max(
+                (r["prefill_tokens"] for r in eng._round_log),
+                default=0),
+        }
+    ratio = out["wholeprompt"]["ttft_p95_ms"] / max(
+        out["chunked"]["ttft_p95_ms"], 1e-9)
+    return {
+        "slots": slots,
+        "chunk_tokens": chunk,
+        "long_prompt_len": long_prompt_len,
+        "n_requests": n_short + 1,
+        "chunked": out["chunked"],
+        "wholeprompt": out["wholeprompt"],
+        "chunked_vs_wholeprompt_ttft": round(ratio, 2),
+        "methodology": (
+            "identical greedy traffic both engines: slots short "
+            "requests decoding when one max-length prompt arrives, then "
+            "a second short wave; TTFT = submit -> first generated "
+            "token; decode p95 = wall ms per decode-token advance per "
+            "scheduler round (whole-prompt admission prefills inside a "
+            "round, so its stall lands here; chunked rounds are "
+            "budget-bounded); both engines compile-warmed off the "
+            "clock; ratio = wholeprompt/chunked p95 TTFT"
+        ),
+    }
+
+
 def run_serving(n_requests=16, slots=8):
-    """bench-model serving row (bf16 decode weights, decode kernel on)."""
+    """bench-model serving row (bf16 decode weights, decode kernel on):
+    the ISSUE-3 continuous-vs-static comparison plus the ISSUE-4
+    long-prompt-admission interference audit."""
     import dataclasses
 
     cfg = dataclasses.replace(make_cfg(1024), params_dtype=jnp.bfloat16)
     model = LlamaModel(cfg)
     params = model.init(jax.random.key(0))
     work, arrivals = make_serving_workload(n_requests)
-    return serving_stats(model, params, work, arrivals, slots=slots)
+    stats = serving_stats(model, params, work, arrivals, slots=slots)
+    stats["interference"] = serving_interference_stats(model, params)
+    return stats
 
 
 def _timed_scan(f, operands, n=20):
@@ -643,7 +743,13 @@ def main():
             f"{serving['serving_tok_s']:.0f} tok/s = "
             f"{serving['continuous_vs_static_tok_s']}x whole-batch on "
             f"mixed-length traffic (p50/p95 "
-            f"{serving['p50_latency_s']}/{serving['p95_latency_s']}s)"
+            f"{serving['p50_latency_s']}/{serving['p95_latency_s']}s); "
+            f"chunked prefill cuts long-prompt-admission p95 TTFT "
+            f"{serving['interference']['chunked_vs_wholeprompt_ttft']}x "
+            f"vs whole-prompt (decode p95 "
+            f"{serving['interference']['chunked']['decode_p95_ms']} vs "
+            f"{serving['interference']['wholeprompt']['decode_p95_ms']}"
+            f" ms)"
         ),
         "value": round(tok1, 1),
         "unit": "tokens/sec/chip",

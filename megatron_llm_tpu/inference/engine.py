@@ -19,18 +19,37 @@ Gemma-on-TPU serving study (arxiv 2605.25645):
   log2(H)+1 scan lengths x {greedy, mixed} are ever traced) —
   admission, retirement and ragged lengths never recompile anything;
 - finished slots retire their pages to a free list and queued requests
-  are admitted mid-flight into the free slots: a bucketed prefill
-  (`bucket_prefill_len` compile shapes) writes the prompt's K/V
-  straight into the slot's pages, then the slot joins the next step;
+  are admitted mid-flight into the free slots;
+- admission is CHUNKED by default (ISSUE 4, after Ragged Paged
+  Attention's mixed-step result): each scheduler round carries a token
+  budget (`prefill_chunk_tokens`) split between ONE resumable prefill
+  chunk — a ragged span of the oldest admitting prompt, at a saved
+  offset — and a single-token decode row for every other live slot,
+  all through one jitted MIXED step (models/attention.py chunked paged
+  branch -> ops/prefill_attention.py). A long prompt therefore delays
+  each in-flight decode token by at most one budget-bounded chunk
+  forward instead of its whole prefill, prompts are never pow2-padded,
+  and only one mixed-step trace exists per pow2 width bucket (vs one
+  whole-prompt prefill executable per prompt bucket).
+  `prefill_chunk_tokens=0` restores whole-prompt admission: a bucketed
+  prefill (`bucket_prefill_len` compile shapes, LRU-bounded executable
+  cache) writes the prompt's K/V into the slot's pages between decode
+  rounds — still the right call for single-tenant short-prompt traffic
+  (docs/GUIDE.md "Chunked prefill");
 - per-request knobs (tokens_to_generate, greedy/top-k/top-p/
   temperature/seed, logprobs) ride per-slot ARRAYS through the step
   function — they are data, not compile-time statics.
 
 Greedy decode is exact-match with `generate_tokens` for the same
-prompt (tests/test_engine.py): the engine splits prefill at the same
-bucket and teacher-forces the remainder, so every position sees the
-identical op sequence; the paged XLA fallback gathers pages into the
-same dense view the dense path reads.
+prompt (tests/test_engine.py) in BOTH admission modes and regardless of
+where chunk boundaries fall: every position's compute is
+row-independent (per-position matmul rows, per-row softmax over the
+same masked columns), so chunking the prompt changes op shapes but not
+values — the token stream is bitwise identical, and logprobs are
+bitwise at matched shapes / within one fp32 ulp when the backend's
+matmul thread-blocking differs across chunk widths (the CPU test
+harness's virtual-device split does this); the paged XLA fallback
+gathers pages into the same dense view the dense path reads.
 
 Scheduling is host-driven (one device scan per loop iteration) because
 admission IS a host decision; the dense engine's while_loop stays the
@@ -63,6 +82,19 @@ _logger = logging.getLogger(__name__)
 class QueueFull(RuntimeError):
     """Raised by submit() when the admission queue is at capacity; the
     HTTP layer maps it to 503 + Retry-After."""
+
+
+def _greedy_pick(last_logits, vocab_size):
+    """The greedy-specialized token decision — argmax on the
+    vocab-clamped logits, no per-row sort machinery. ONE definition
+    shared by the decode-scan and mixed-step builders: the engine's
+    tokens must be independent of which step flavor served them, so the
+    two paths may never drift numerically."""
+    l = last_logits.astype(jnp.float32)
+    if vocab_size is not None and vocab_size < l.shape[-1]:
+        pad = jnp.arange(l.shape[-1]) >= vocab_size
+        l = jnp.where(pad[None, :], NEG_INF, l)
+    return jnp.argmax(l, axis=-1).astype(jnp.int32)
 
 
 def _per_slot_sample(logits, greedy, temperature, top_k, top_p, seeds,
@@ -128,6 +160,7 @@ class EngineRequest:
     done: threading.Event = field(default_factory=threading.Event)
     t_submit: float = 0.0
     t_admit: float = 0.0
+    t_first: float = 0.0  # first GENERATED token (TTFT = t_first - t_submit)
     t_done: float = 0.0
 
     def result(self, timeout: Optional[float] = None):
@@ -147,6 +180,14 @@ class _Slot:
     forced: collections.deque = field(default_factory=collections.deque)
     generated: int = 0
     sample_step: int = 0
+    # chunked admission: next prompt position to prefill (the resumable
+    # saved offset); == len(req.prompt) once prefill is complete
+    prefill_pos: int = 0
+
+    @property
+    def prefilling(self) -> bool:
+        return self.req is not None and self.prefill_pos < len(
+            self.req.prompt)
 
 
 def _make_step_fn(model, vocab_size, horizon, all_greedy):
@@ -176,13 +217,8 @@ def _make_step_fn(model, vocab_size, horizon, all_greedy):
                 # every live request is greedy (the serving-bench hot
                 # path): the per-row sort/cumsum machinery of the
                 # sampled branch would cost a full (slots, V) sort per
-                # token for nothing — argmax on the clamped logits is
-                # the entire decision
-                l = last_logits.astype(jnp.float32)
-                if vocab_size is not None and vocab_size < l.shape[-1]:
-                    pad = jnp.arange(l.shape[-1]) >= vocab_size
-                    l = jnp.where(pad[None, :], NEG_INF, l)
-                sampled = jnp.argmax(l, axis=-1).astype(jnp.int32)
+                # token for nothing
+                sampled = _greedy_pick(last_logits, vocab_size)
             else:
                 sampled = _per_slot_sample(
                     last_logits, greedy, temperature, top_k, top_p,
@@ -210,6 +246,78 @@ def _make_step_fn(model, vocab_size, horizon, all_greedy):
         pools_k, pools_v, _, last_logits, _ = carry
         # (horizon, slots) -> (slots, horizon)
         return (chosen_h.T, lp_h.T, last_logits, pools_k, pools_v)
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def _make_mixed_step_fn(model, vocab_size, width, all_greedy):
+    """The jitted MIXED prefill+decode step (chunked admission), traced
+    once per (engine, pow2 width bucket, greedy specialization): every
+    slot contributes one ragged span through the chunked paged stack —
+    the admitting slot a prefill chunk of up to `width` prompt tokens at
+    its saved offset, each decoding slot a single sampled/greedy token,
+    idle slots nothing (chunk_lens 0) — and attention for all of them
+    runs in ONE ragged paged pass (ops/prefill_attention.py). Decode
+    rows sample from the carried last_logits BEFORE the forward, exactly
+    like the decode scan body, so tokens and logprobs are independent of
+    which step flavor served them. Page pools are donated — the update
+    is in place.
+
+    Returns per-slot (first token, its logprob under last_logits),
+    the CHUNK slot's in-chunk logprobs [lp of chunk token p+1 at p],
+    the new last logits, and the pools. last_logits is PRESERVED for
+    idle slots."""
+
+    def step(dec_params, pools_k, pools_v, page_table, lengths,
+             last_logits, chunk_tokens, chunk_lens, is_prefill,
+             chunk_idx, greedy, temperature, top_k, top_p, seeds,
+             sample_steps):
+        active = chunk_lens > 0
+        lp_full = jax.nn.log_softmax(
+            last_logits.astype(jnp.float32), axis=-1)
+        if all_greedy:
+            sampled = _greedy_pick(last_logits, vocab_size)
+        else:
+            sampled = _per_slot_sample(
+                last_logits, greedy, temperature, top_k, top_p, seeds,
+                sample_steps, vocab_size)
+        first = jnp.where(is_prefill, chunk_tokens[:, 0], sampled)
+        first = jnp.where(active, first, 0)
+        first_lp = jnp.take_along_axis(
+            lp_full, first[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        toks = chunk_tokens.at[:, 0].set(first)
+        caches = {"k_pages_layers": pools_k, "v_pages_layers": pools_v,
+                  "page_table": page_table, "lengths": lengths,
+                  "chunk_lens": chunk_lens}
+        logits, new_caches = model.forward(
+            dec_params, toks, kv_caches=caches,
+            position_ids=lengths[:, None] + jnp.arange(width)[None, :],
+        )
+        if width > 1:
+            # lp of chunk token p+1 under the logits at p — the prompt-
+            # logprob stream of a prefill chunk (position p's target is
+            # the NEXT prompt token; the chunk's last target arrives
+            # next round via first_lp, the decode scan's layout). Only
+            # the ONE prefill chunk row ever needs this, so slice it
+            # out before the (width, V) log_softmax instead of paying a
+            # (slots, width, V) one on every mixed round — these are
+            # exactly the rounds the decode-interference gauge watches.
+            row_logits = logits[chunk_idx, :-1]
+            lp_in = jax.nn.log_softmax(
+                row_logits.astype(jnp.float32), axis=-1)
+            row_toks = jax.lax.dynamic_index_in_dim(
+                toks, chunk_idx, 0, keepdims=False)[1:]
+            chunk_lps = jnp.take_along_axis(
+                lp_in, row_toks[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        else:
+            chunk_lps = jnp.zeros((0,), jnp.float32)
+        last_idx = jnp.clip(chunk_lens - 1, 0, width - 1)
+        new_last = jnp.take_along_axis(
+            logits, last_idx[:, None, None], axis=1)[:, 0]
+        new_last = jnp.where(active[:, None], new_last, last_logits)
+        return (first, first_lp, chunk_lps, new_last,
+                new_caches["k_pages_layers"],
+                new_caches["v_pages_layers"])
 
     return jax.jit(step, donate_argnums=(1, 2))
 
@@ -263,6 +371,19 @@ class DecodeEngine:
       scan) — amortizes dispatch latency at the price of quantizing
       admission/retirement latency; clamped per call to the nearest
       slot completion so no budget is overrun mid-scan.
+    - `prefill_chunk_tokens`: per-round prompt-token budget of chunked
+      admission (the mixed prefill+decode step). While any slot is
+      admitting, each round prefills at most this many tokens of the
+      OLDEST admitting prompt and advances every other live slot by one
+      decode token in the same jitted dispatch — the decode-latency
+      interference of a long prompt is bounded by one budget-sized
+      chunk forward per token. 0 disables chunking: whole-prompt
+      bucketed prefill at admission (the pre-ISSUE-4 behavior; wins for
+      single-tenant short-prompt traffic, docs/GUIDE.md).
+    - `warmup_compile`: pre-trace the mixed-step/decode-scan
+      executables for the configured buckets at `start()` so the first
+      request doesn't eat the compile stall (opt-in; warmup rounds run
+      every slot idle, so they only scribble the dead null page).
 
     Pages are reserved UP FRONT at admission for the request's whole
     prompt + tokens_to_generate reach, so a running request can never
@@ -274,6 +395,8 @@ class DecodeEngine:
                  page_size: int = 64, max_context: int = 1024,
                  page_budget: Optional[int] = None, max_queue: int = 64,
                  step_horizon: int = 8,
+                 prefill_chunk_tokens: int = 256,
+                 warmup_compile: bool = False,
                  termination_id: Optional[int] = None,
                  vocab_size: Optional[int] = None, timers=None):
         assert max_context % page_size == 0, \
@@ -295,6 +418,11 @@ class DecodeEngine:
         # is overrun, and buckets the clamp to powers of two so at most
         # log2(step_horizon)+1 scan lengths are ever traced)
         self.step_horizon = max(1, step_horizon)
+        assert prefill_chunk_tokens >= 0
+        if prefill_chunk_tokens > max_context:
+            prefill_chunk_tokens = max_context
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        self.warmup_compile = warmup_compile
         self.termination_id = termination_id
         self.vocab_size = vocab_size
         self.timers = timers
@@ -323,14 +451,31 @@ class DecodeEngine:
         self._broken: Optional[str] = None
 
         self._step_fns: dict = {}  # horizon bucket -> jitted scan
-        self._prefill_fns: dict = {}
+        self._mixed_fns: dict = {}  # (width bucket, greedy) -> jitted
+        # whole-prompt prefill executables, LRU-bounded like the pp
+        # decode cache (api.py _pp_decode_fn): prompt buckets are an
+        # unbounded key space across traffic
+        self._prefill_fns: "collections.OrderedDict" = \
+            collections.OrderedDict()
 
         # counters (exported through the timers-gauge path)
         self._admitted = 0
         self._retired = 0
         self._steps = 0
         self._tokens_out = 0
+        self._prefill_tokens = 0
         self._t0 = time.perf_counter()
+        # recent-window latency gauges: submit -> first generated token
+        # per request, and wall ms per decode-token advance per round
+        # (a mixed round IS one decode step — its latency is exactly the
+        # chunked-prefill interference the p95 gauge exists to expose)
+        self._ttft_ms: collections.deque = collections.deque(maxlen=256)
+        self._decode_ms: collections.deque = collections.deque(maxlen=256)
+        # per-round accounting (prefill/decode token split + wall ms),
+        # the auditable budget trail (tests pin the interference bound
+        # on it; bench reads it for the decode-p95 row)
+        self._round_log: collections.deque = collections.deque(
+            maxlen=4096)
 
     # -- admission ---------------------------------------------------------
 
@@ -386,27 +531,51 @@ class DecodeEngine:
             self._work.notify()
         return req
 
-    def _prefill_fn(self, plen):
-        if plen not in self._prefill_fns:
-            self._prefill_fns[plen] = _make_prefill_fn(
-                self.model, plen, self.page_size)
-        return self._prefill_fns[plen]
+    _PREFILL_CACHE_CAP = 8
 
-    def _admit(self):
+    def _prefill_fn(self, plen):
+        """Whole-prompt prefill executable per bucket, LRU-bounded at
+        _PREFILL_CACHE_CAP (requeue-on-hit, loud eviction) — the same
+        contract as the pp decode cache (api.py _pp_decode_fn): prompt
+        buckets are a small-but-unbounded key space across traffic, and
+        an unbounded dict held every executable forever."""
+        if plen in self._prefill_fns:
+            fn = self._prefill_fns.pop(plen)
+            self._prefill_fns[plen] = fn  # LRU requeue
+            return fn
+        while len(self._prefill_fns) >= self._PREFILL_CACHE_CAP:
+            old, _ = self._prefill_fns.popitem(last=False)
+            _logger.warning(
+                "prefill executable cache full (%d): evicting LRU bucket "
+                "%d; the next prompt at that bucket recompiles its "
+                "prefill (chunked admission — prefill_chunk_tokens > 0 — "
+                "avoids per-prompt buckets entirely)",
+                self._PREFILL_CACHE_CAP, old,
+            )
+        fn = _make_prefill_fn(self.model, plen, self.page_size)
+        self._prefill_fns[plen] = fn
+        return fn
+
+    def _admit(self) -> int:
         """Move queued requests into free slots while pages allow.
         FIFO head-of-line: a request that does not fit blocks the ones
-        behind it (predictable latency ordering, no starvation)."""
+        behind it (predictable latency ordering, no starvation).
+        Returns the prompt tokens PREFILLED ON DEVICE during this call
+        (whole-prompt mode only; chunked admission does no device work
+        here), so the caller's round accounting can attribute the
+        in-round prefill stall honestly."""
+        prefilled = 0
         for si, slot in enumerate(self._slots):
             if slot.req is not None:
                 continue
             with self._lock:
                 if not self._queue:
-                    return
+                    return prefilled
                 req = self._queue[0]
                 need = -(-(len(req.prompt) + req.tokens_to_generate)
                          // self.page_size)
                 if len(self._free_pages) < need:
-                    return
+                    return prefilled
                 self._queue.popleft()
                 # claim the slot INSIDE the lock: stop(drain=True) polls
                 # "queue empty and no slot busy" — a request must never
@@ -415,25 +584,38 @@ class DecodeEngine:
             pages = [self._free_pages.pop() for _ in range(need)]
             self._pt[si] = 0
             self._pt[si, :need] = pages
-            plen = bucket_prefill_len(len(req.prompt))
-            self._pools_k, self._pools_v, row_logits, plp = \
-                self._prefill_fn(plen)(
-                    self._dec_params, self._pools_k, self._pools_v,
-                    jnp.asarray(np.asarray(req.prompt[:plen],
-                                           np.int32)[None]),
-                    jnp.asarray(self._pt[si]),
-                )
-            self._last_logits = self._last_logits.at[si].set(row_logits)
-            self._lengths[si] = plen
             slot.pages = pages
-            slot.forced = collections.deque(req.prompt[plen:])
             slot.generated = 0
             slot.sample_step = 0
             req.tokens = list(req.prompt)
-            if req.return_log_probs:
-                req.log_probs = [float(x) for x in np.asarray(plp)]
+            if self.prefill_chunk_tokens:
+                # chunked admission: no device work here — the prompt
+                # prefills incrementally through the mixed rounds,
+                # resumable at slot.prefill_pos
+                slot.prefill_pos = 0
+                slot.forced = collections.deque()
+                self._lengths[si] = 0
+            else:
+                plen = bucket_prefill_len(len(req.prompt))
+                self._pools_k, self._pools_v, row_logits, plp = \
+                    self._prefill_fn(plen)(
+                        self._dec_params, self._pools_k, self._pools_v,
+                        jnp.asarray(np.asarray(req.prompt[:plen],
+                                               np.int32)[None]),
+                        jnp.asarray(self._pt[si]),
+                    )
+                self._last_logits = \
+                    self._last_logits.at[si].set(row_logits)
+                self._lengths[si] = plen
+                slot.prefill_pos = len(req.prompt)
+                slot.forced = collections.deque(req.prompt[plen:])
+                self._prefill_tokens += plen
+                prefilled += plen
+                if req.return_log_probs:
+                    req.log_probs = [float(x) for x in np.asarray(plp)]
             req.t_admit = time.perf_counter()
             self._admitted += 1
+        return prefilled
 
     def _retire(self, si: int):
         slot = self._slots[si]
@@ -456,14 +638,78 @@ class DecodeEngine:
                 self.model, self.vocab_size, horizon, all_greedy)
         return self._step_fns[key]
 
+    def _mixed_fn(self, width, all_greedy):
+        key = (width, all_greedy)
+        if key not in self._mixed_fns:
+            self._mixed_fns[key] = _make_mixed_step_fn(
+                self.model, self.vocab_size, width, all_greedy)
+        return self._mixed_fns[key]
+
+    def _chunk_width(self, remaining: int) -> int:
+        """Pow2 width bucket for a chunk covering `remaining` prompt
+        tokens, capped at the budget: the mixed step traces once per
+        distinct width, so at most log2(prefill_chunk_tokens)+1
+        executables exist regardless of prompt lengths."""
+        c = self.prefill_chunk_tokens
+        if remaining >= c:
+            return c
+        return min(1 << (max(remaining, 1) - 1).bit_length(), c)
+
+    def _book_token(self, i: int, tok: int, now: Optional[float] = None
+                    ) -> bool:
+        """Record one GENERATED token for slot i (TTFT on the first);
+        retires the slot on eod/budget. Returns True if it retired."""
+        s = self._slots[i]
+        r = s.req
+        r.tokens.append(tok)
+        s.generated += 1
+        s.sample_step += 1
+        self._tokens_out += 1
+        if s.generated == 1:
+            r.t_first = now if now is not None else time.perf_counter()
+            with self._lock:  # counters() sorts this window concurrently
+                self._ttft_ms.append((r.t_first - r.t_submit) * 1e3)
+        hit_eod = (r.use_eod_for_early_termination
+                   and self.termination_id is not None
+                   and tok == self.termination_id)
+        if hit_eod or s.generated >= r.tokens_to_generate:
+            self._retire(i)
+            return True
+        return False
+
     def step(self) -> bool:
-        """One scheduler iteration: admit, run ONE jitted scan of up to
-        `step_horizon` decode steps over every live slot, book tokens,
-        retire finished. The horizon is clamped to the nearest slot
-        completion (so no request overruns its budget mid-scan) and
-        bucketed to a power of two (bounded trace count). Returns False
-        when there was nothing to do (idle)."""
-        self._admit()
+        """One scheduler iteration. Chunked admission (the default):
+        while any slot is mid-prefill, run one MIXED round — a budget-
+        bounded ragged chunk of the oldest admitting prompt plus one
+        decode token for every other live slot, one jitted dispatch —
+        otherwise one jitted scan of up to `step_horizon` decode steps.
+        Each round's prefill/decode token split and wall time land in
+        `_round_log` (the budget audit trail) and the decode-latency
+        window behind `serve_decode_p95_ms`. Returns False when there
+        was nothing to do (idle)."""
+        t0 = time.perf_counter()
+        admit_prefilled = self._admit()
+        if self.prefill_chunk_tokens and any(
+                s.prefilling for s in self._slots):
+            dec_steps, pf_tokens = self._mixed_round()
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:  # counters() reads these windows concurrently
+                self._round_log.append({
+                    "prefill_tokens": pf_tokens, "decode_steps": 1,
+                    "decode_slots": dec_steps, "ms": dt_ms})
+                if dec_steps:
+                    self._decode_ms.append(dt_ms)
+            return True
+        return self._decode_round(t0, admit_prefilled)
+
+    def _decode_round(self, t0: float, prefill_tokens: int = 0) -> bool:
+        """One jitted scan of up to `step_horizon` decode steps over
+        every live slot (the decode-only round). The horizon is clamped
+        to the nearest slot completion (so no request overruns its
+        budget mid-scan) and bucketed to a power of two (bounded trace
+        count). `prefill_tokens` is the device prefill _admit() ran
+        inside this round (whole-prompt mode) — its stall is inside
+        this round's wall time, so the audit entry must carry it."""
         live = [i for i, s in enumerate(self._slots) if s.req is not None]
         if not live:
             return False
@@ -516,6 +762,7 @@ class DecodeEngine:
         chosen_lp = np.asarray(chosen_lp)
         self._steps += hor
 
+        now = time.perf_counter()
         for t in range(hor):
             for i in live:
                 s = self._slots[i]
@@ -528,17 +775,101 @@ class DecodeEngine:
                 if s.forced:
                     s.forced.popleft()  # prompt token, already in tokens
                     continue
-                tok = int(chosen[i, t])
-                r.tokens.append(tok)
-                s.generated += 1
-                s.sample_step += 1
-                self._tokens_out += 1
-                hit_eod = (r.use_eod_for_early_termination
-                           and self.termination_id is not None
-                           and tok == self.termination_id)
-                if hit_eod or s.generated >= r.tokens_to_generate:
-                    self._retire(i)
+                self._book_token(i, int(chosen[i, t]), now)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:  # counters() reads these windows concurrently
+            self._round_log.append({
+                "prefill_tokens": prefill_tokens, "decode_steps": hor,
+                "decode_slots": len(live), "ms": dt_ms})
+            # per decode-token-advance latency: the scan amortizes hor
+            # steps (the whole-prompt admission stall, when any, rides
+            # this round's wall time — that IS the interference)
+            self._decode_ms.append(dt_ms / hor)
         return True
+
+    def _mixed_round(self):
+        """One mixed prefill+decode round (chunked admission): the
+        OLDEST admitting slot (FIFO by rid — bounds per-round prefill
+        tokens to ONE chunk <= the budget) contributes a ragged prompt
+        span resumed at its saved offset; every fully-prefilled live
+        slot contributes one decode token; other admitting slots sit
+        idle (chunk_lens 0). One jitted dispatch serves all of it.
+        Returns (decode slots advanced, prefill tokens consumed)."""
+        n = self.slots
+        pref = [i for i, s in enumerate(self._slots) if s.prefilling]
+        ci = min(pref, key=lambda i: self._slots[i].req.rid)
+        s_c = self._slots[ci]
+        remaining = len(s_c.req.prompt) - s_c.prefill_pos
+        width = self._chunk_width(remaining)
+        ln = min(remaining, width)
+        dec = [i for i, s in enumerate(self._slots)
+               if s.req is not None and not s.prefilling]
+
+        chunk_tokens = np.zeros((n, width), np.int32)
+        chunk_lens = np.zeros((n,), np.int32)
+        is_prefill = np.zeros((n,), bool)
+        greedy = np.ones(n, bool)
+        temperature = np.ones(n, np.float32)
+        top_k = np.zeros(n, np.int32)
+        top_p = np.zeros(n, np.float32)
+        seeds = np.zeros(n, np.uint32)
+        sample_steps = np.zeros(n, np.int32)
+        chunk_tokens[ci, :ln] = s_c.req.prompt[
+            s_c.prefill_pos:s_c.prefill_pos + ln]
+        chunk_lens[ci] = ln
+        is_prefill[ci] = True
+        for i in dec:
+            r = self._slots[i].req
+            chunk_lens[i] = 1
+            greedy[i] = r.greedy
+            temperature[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            seeds[i] = np.uint32(r.seed & 0xFFFFFFFF)
+            sample_steps[i] = self._slots[i].sample_step
+        all_greedy = all(self._slots[i].req.greedy for i in dec)
+
+        (first, first_lp, chunk_lps, new_last, self._pools_k,
+         self._pools_v) = self._mixed_fn(width, all_greedy)(
+            self._dec_params, self._pools_k, self._pools_v,
+            jnp.asarray(self._pt), jnp.asarray(self._lengths),
+            self._last_logits, jnp.asarray(chunk_tokens),
+            jnp.asarray(chunk_lens), jnp.asarray(is_prefill),
+            jnp.asarray(ci, jnp.int32),
+            jnp.asarray(greedy), jnp.asarray(temperature),
+            jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(seeds), jnp.asarray(sample_steps),
+        )
+        self._last_logits = new_last
+        first = np.asarray(first)
+        first_lp = np.asarray(first_lp)
+        chunk_lps = np.asarray(chunk_lps)
+        self._steps += 1
+        self._prefill_tokens += ln
+
+        # prefill slot: advance the saved offset, book prompt logprobs
+        # (position p predicts prompt token p+1; the chunk's first token
+        # was predicted by last round's final logits = first_lp)
+        r = s_c.req
+        if r.return_log_probs:
+            if s_c.prefill_pos > 0:
+                r.log_probs.append(float(first_lp[ci]))
+            if ln > 1:
+                r.log_probs.extend(
+                    float(x) for x in chunk_lps[:ln - 1])
+        s_c.prefill_pos += ln
+        self._lengths[ci] += ln
+
+        # decode slots: one token each, the scan-path bookkeeping at
+        # horizon 1
+        now = time.perf_counter()
+        for i in dec:
+            r = self._slots[i].req
+            self._lengths[i] += 1
+            if r.return_log_probs:
+                r.log_probs.append(float(first_lp[i]))
+            self._book_token(i, int(first[i]), now)
+        return len(dec), ln
 
     def drain(self):
         """Run until the queue and every slot are empty."""
@@ -561,8 +892,67 @@ class DecodeEngine:
                 s.req.error = msg
                 self._retire(i)
 
+    def warmup(self):
+        """Pre-trace every step executable the configured buckets can
+        reach — the pow2 decode-scan horizons and (chunked mode) the
+        pow2 mixed-step widths, greedy-specialized (the serving hot
+        path) — so the first request never eats a compile stall.
+        Warmup rounds run with every slot idle against the REAL pools:
+        all K/V writes land on the dead null page (all-zero page-table
+        rows), lengths are untouched on the host, and the returned
+        last_logits is discarded, so warmup is invisible to traffic.
+        Opt-in: `warmup_compile=True` runs it inside `start()`."""
+        n = self.slots
+        zeros_i = np.zeros((n,), np.int32)
+        null_pt = jnp.asarray(np.zeros_like(self._pt))
+        horizons = []
+        h = 1
+        top = 1 << (self.step_horizon.bit_length() - 1)
+        while h <= top:
+            horizons.append(h)
+            h *= 2
+        for h in horizons:
+            (_, _, _, self._pools_k, self._pools_v) = self._step_fn(
+                h, True)(
+                self._dec_params, self._pools_k, self._pools_v,
+                null_pt, jnp.asarray(zeros_i), self._last_logits,
+                jnp.asarray(np.zeros(n, bool)),
+                jnp.asarray(np.zeros((n, h), np.int32)),
+                jnp.asarray(np.zeros((n, h), bool)),
+                jnp.asarray(np.ones(n, bool)),
+                jnp.asarray(np.ones(n, np.float32)),
+                jnp.asarray(zeros_i),
+                jnp.asarray(np.zeros(n, np.float32)),
+                jnp.asarray(np.zeros(n, np.uint32)),
+                jnp.asarray(zeros_i),
+            )
+        if self.prefill_chunk_tokens:
+            widths = {self.prefill_chunk_tokens}
+            w = 1
+            while w < self.prefill_chunk_tokens:
+                widths.add(w)
+                w *= 2
+            for w in sorted(widths):
+                (_, _, _, _, self._pools_k, self._pools_v) = \
+                    self._mixed_fn(w, True)(
+                    self._dec_params, self._pools_k, self._pools_v,
+                    null_pt, jnp.asarray(zeros_i), self._last_logits,
+                    jnp.asarray(np.zeros((n, w), np.int32)),
+                    jnp.asarray(zeros_i),
+                    jnp.asarray(np.zeros(n, bool)),
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(np.ones(n, bool)),
+                    jnp.asarray(np.ones(n, np.float32)),
+                    jnp.asarray(zeros_i),
+                    jnp.asarray(np.zeros(n, np.float32)),
+                    jnp.asarray(np.zeros(n, np.uint32)),
+                    jnp.asarray(zeros_i),
+                )
+
     def start(self):
         assert self._thread is None, "engine already started"
+        if self.warmup_compile:
+            self.warmup()
         self._running = True
 
         def loop():
@@ -611,11 +1001,32 @@ class DecodeEngine:
 
     # -- observability -----------------------------------------------------
 
+    @staticmethod
+    def _pct(window, p: float) -> float:
+        xs = sorted(window)
+        if not xs:
+            return 0.0
+        return xs[min(int(p * len(xs)), len(xs) - 1)]
+
     def counters(self) -> dict:
         """Live serving counters; exported via `export_gauges` through
-        the existing timers-gauge path (training/timers.py)."""
+        the existing timers-gauge path (training/timers.py) and served
+        by the HTTP layer at GET /metrics (inference/server.py). The
+        latency gauges are recent-window percentiles (last 256):
+        `serve_ttft_*` = submit -> first GENERATED token per request,
+        `serve_decode_p95_ms` = wall ms per decode-token advance per
+        round — during chunked admission a mixed round IS one decode
+        step, so this gauge is the chunked-prefill interference bound
+        made visible."""
         occupied = sum(1 for s in self._slots if s.req is not None)
         dt = max(time.perf_counter() - self._t0, 1e-9)
+        with self._lock:
+            # snapshot the latency windows under the lock (the serve
+            # loop appends to them under the same lock): sorting a
+            # deque mid-append raises RuntimeError, and GET /metrics
+            # must never die mid-traffic
+            ttft = list(self._ttft_ms)
+            decode_ms = list(self._decode_ms)
         return {
             "serve_slot_occupancy": occupied / self.slots,
             "serve_queue_depth": len(self._queue),
@@ -626,6 +1037,10 @@ class DecodeEngine:
             "serve_retired": self._retired,
             "serve_steps": self._steps,
             "serve_tok_s": round(self._tokens_out / dt, 2),
+            "serve_prefill_tokens": self._prefill_tokens,
+            "serve_ttft_p50_ms": round(self._pct(ttft, 0.50), 2),
+            "serve_ttft_p95_ms": round(self._pct(ttft, 0.95), 2),
+            "serve_decode_p95_ms": round(self._pct(decode_ms, 0.95), 2),
         }
 
     def export_gauges(self, timers=None):

@@ -18,6 +18,11 @@ concurrent request gets 503 + Retry-After instead of stacking device
 work behind a blocked thread (two unlocked concurrent PUTs used to race
 on the same device; stacking them hid the overload from the client).
 `MegatronServer.stop()` drains the engine before returning.
+
+GET /metrics (engine-attached servers) returns the live
+`DecodeEngine.counters()` dict — slot occupancy, queue depth, page
+accounting, tok/s, and the ISSUE-4 latency gauges (serve_ttft_p50_ms /
+serve_ttft_p95_ms / serve_decode_p95_ms) — as JSON.
 """
 
 from __future__ import annotations
@@ -272,6 +277,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+            return
+        if self.path.rstrip("/") == "/metrics":
+            # live engine counters (DecodeEngine.counters — occupancy,
+            # queue depth, pages, tok/s, and the latency gauges
+            # serve_ttft_p50/p95_ms + serve_decode_p95_ms) as JSON; the
+            # same dict the timers-gauge export carries, so dashboards
+            # and curl read one schema. 404 when no engine is attached
+            # (whole-batch-only server has no per-request gauges).
+            if self.generator.engine is None:
+                self.send_error(404)
+                return
+            self._respond(self.generator.engine.counters(), 200)
             return
         self.send_error(404)
 

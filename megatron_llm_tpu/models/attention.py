@@ -239,7 +239,13 @@ def attention_block(
       (slots, max_pages) int32, "lengths": (slots,) int32} — the batch
       axis is SLOTS at ragged per-slot lengths; this step's token K/V is
       scattered into each slot's current page and attention streams only
-      the pages a slot owns (ops/decode_attention.paged_decode_attention).
+      the pages a slot owns (ops/decode_attention.paged_decode_attention);
+    - chunked paged (the engine's mixed prefill+decode step): the paged
+      form plus {"chunk_lens": (slots,) int32} — slot i contributes a
+      ragged span of chunk_lens[i] tokens starting at cache position
+      lengths[i] (s is the padded chunk width; 1 == a decode row, 0 ==
+      idle), scattered + attended in one ragged pass
+      (ops/prefill_attention.ragged_paged_prefill).
     """
     b, s, h = hidden.shape
     compute_dtype = cfg.compute_dtype
@@ -254,6 +260,51 @@ def attention_block(
     q, k, v = split_qkv(mixed, cfg)
     q = shard_activation(q, "groups")
 
+    if kv_cache is not None and "k_pages" in kv_cache \
+            and "chunk_lens" in kv_cache:
+        # chunked ragged prefill (the mixed prefill+decode step of the
+        # continuous-batching engine, ISSUE 4): slot i contributes a
+        # contiguous span of chunk_lens[i] tokens (<= s, ragged; 0 =
+        # idle) starting at cache position lengths[i]. The span's K/V is
+        # scattered into the slot's pages and attention runs causally
+        # against everything the slot has cached INCLUDING the span
+        # itself, in one pass (ops/prefill_attention.py). A decode row
+        # is the chunk_lens == 1 special case, so prefill chunks and
+        # decode rows share this branch inside one jitted step.
+        g, qpk, d = cfg.num_query_groups, cfg.q_per_kv, cfg.head_dim
+        lengths = kv_cache["lengths"]
+        chunk_lens = kv_cache["chunk_lens"]
+        page_table = kv_cache["page_table"]
+        if position_ids is None:
+            position_ids = lengths[:, None] + jnp.arange(s)[None, :]
+        if rope_table is not None:
+            q = apply_rope(q, rope_table, position_ids)
+            k = apply_rope(k, rope_table, position_ids)
+        from megatron_llm_tpu.ops.prefill_attention import (
+            ragged_paged_prefill,
+        )
+
+        # one gate, inside the entry point (ragged_prefill_block):
+        # use_pallas=True means "kernel if eligible, XLA twin
+        # otherwise"; min_cache matches the paged-decode gate so decode
+        # rows take the SAME kernel-vs-XLA path in mixed and scan steps
+        ctx, kp, vp = ragged_paged_prefill(
+            q, k, v, kv_cache["k_pages"], kv_cache["v_pages"],
+            page_table, lengths, chunk_lens,
+            use_pallas=cfg.use_decode_attn,
+            min_cache=cfg.decode_attn_min_cache,
+            interpret=cfg.decode_attn_interpret,
+        )
+        new_cache = {"k_pages": kp, "v_pages": vp,
+                     "page_table": page_table,
+                     "lengths": lengths + chunk_lens,
+                     "chunk_lens": chunk_lens}
+        ctx = shard_activation(ctx.reshape(b, s, g, qpk * d), "heads") \
+            .reshape(b, s, -1)
+        out = ctx @ attn_params["wo"].astype(compute_dtype)
+        if "bo" in attn_params:
+            out = out + attn_params["bo"].astype(compute_dtype)
+        return out, new_cache
     if kv_cache is not None and "k_pages" in kv_cache:
         # paged decode step (s == 1): slot i's token sits at position
         # lengths[i]; its K/V lands in pool page

@@ -300,19 +300,31 @@ def transformer_stack(
             # fast path — standalone per-layer buffers, no stack slicing.
             pt = kv_caches["page_table"]
             lens = kv_caches["lengths"]
+            # chunked mixed prefill+decode step (ISSUE 4): per-slot
+            # ragged chunk lengths ride through every layer (the layer
+            # branch scatters + attends the whole span at once); the
+            # stack-level length advance is ragged too
+            cl = kv_caches.get("chunk_lens")
             ks = list(kv_caches["k_pages_layers"])
             vs = list(kv_caches["v_pages_layers"])
             for i in range(L):
                 cache_l = {"k_pages": ks[i], "v_pages": vs[i],
                            "page_table": pt, "lengths": lens}
+                if cl is not None:
+                    cache_l["chunk_lens"] = cl
                 (hidden,), nc = body(
                     (hidden,), (layer_params[i], idxs[i], cache_l)
                 )
                 ks[i], vs[i] = nc["k_pages"], nc["v_pages"]
-            return hidden, {
+            new_caches = {
                 "k_pages_layers": tuple(ks), "v_pages_layers": tuple(vs),
-                "page_table": pt, "lengths": lens + hidden.shape[1],
+                "page_table": pt,
+                "lengths": lens + (cl if cl is not None
+                                   else hidden.shape[1]),
             }
+            if cl is not None:
+                new_caches["chunk_lens"] = cl
+            return hidden, new_caches
         offset = kv_caches["offset"]
         ks = list(kv_caches["k_layers"])
         vs = list(kv_caches["v_layers"])

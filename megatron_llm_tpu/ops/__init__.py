@@ -1,7 +1,8 @@
 """Pallas TPU kernels: flash attention (training), decode attention
-(KV-cached serving), fused RMSNorm. Each module dispatches to a
-numerically matching XLA path off-TPU; `interpret=True` runs the real
-kernels through the Pallas interpreter (the CPU test suites)."""
+(KV-cached serving), ragged paged prefill (chunked prompt admission),
+fused RMSNorm. Each module dispatches to a numerically matching XLA
+path off-TPU; `interpret=True` runs the real kernels through the Pallas
+interpreter (the CPU test suites)."""
 
 from megatron_llm_tpu.ops.decode_attention import (  # noqa: F401
     decode_attention,
@@ -10,4 +11,8 @@ from megatron_llm_tpu.ops.decode_attention import (  # noqa: F401
 from megatron_llm_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     flash_attention_with_lse,
+)
+from megatron_llm_tpu.ops.prefill_attention import (  # noqa: F401
+    ragged_paged_prefill,
+    ragged_prefill_block,
 )

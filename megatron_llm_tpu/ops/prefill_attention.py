@@ -1,0 +1,373 @@
+"""Pallas ragged paged prefill kernel — chunked prompt admission against
+the page pool (ISSUE 4 tentpole, after Ragged Paged Attention — arxiv
+2604.15464).
+
+The continuous-batching engine (inference/engine.py) used to admit a
+request by running its WHOLE prompt through a bucketed dense prefill
+between decode rounds: a long prompt stalled every in-flight decode slot
+for the full prefill, pow2 bucketing padded short prompts, and each
+bucket minted its own executable. This module is the kernel side of the
+fix: one launch serves a batch of ragged QUERY CHUNKS — each chunk a
+contiguous span of one slot's prompt, at an arbitrary start offset —
+reading K/V through the same scalar-prefetched per-slot page table the
+paged decode kernel uses. A single-token decode row is just a chunk of
+length 1 at offset length-1, so mixed prefill+decode steps run through
+ONE code path (models/attention.py chunked paged branch).
+
+Kernel structure (the decode/flash family conventions):
+
+- grid (chunk, group, q_block, page): each grid step reads one pool page
+  ONCE per GQA group and serves all `q_per_kv` query heads of the group
+  from it; the page dim carries the online-softmax state in VMEM
+  scratch (exp2 domain, fp32 accumulation — the flash forward scheme);
+- the per-chunk START OFFSET and VALID LENGTH ride scalar-prefetch
+  operands: causal-within-chunk masking is `col <= start + row`, rows
+  past the chunk's valid length are pad (exact-zero output, the empty-
+  slot contract of the paged decode kernel), and the K/V index map
+  dereferences the page table with past-the-need pages clamped to the
+  last needed page — Mosaic elides the repeated DMA, so cache traffic
+  follows `start + len`, not the allocated table width;
+- interior/boundary split: page blocks fully below the causal diagonal
+  and fully inside the valid length run maskless; only straddling
+  blocks pay the iota/select VPU work (split_boundary=False under the
+  interpreter, the same vma workaround as the flash/decode kernels).
+
+`ragged_paged_prefill` is the public entry: it first SCATTERS the
+chunk's own K/V into its slot's pages (valid rows only; pad rows land
+on the pool's dead null page 0), then attends — one jitted pass, so the
+chunk's in-span causal columns are read back from the pool it just
+wrote. `_xla_ragged_prefill` (gather pages to the dense view, mask,
+softmax — the `_xla_paged_decode` op sequence generalized to ragged
+rows) is the numerically matching fallback and the CPU test oracle;
+`interpret=True` runs the real kernel through the Pallas interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from megatron_llm_tpu.ops.flash_attention import (
+    LOG2E,
+    NEG_INF,
+    _compiler_params,
+    _out_struct,
+)
+
+# folded (token, head) rows per grid program — the flash kernels' VMEM
+# bound for the fp32 score block and accumulator
+MAX_PREFILL_ROWS = 2048
+
+
+def _choose_block_q(C: int, qpk: int) -> Optional[int]:
+    """Largest power-of-2 q block (in TOKENS) dividing the padded chunk
+    width C with folded rows (block * qpk) under MAX_PREFILL_ROWS.
+    Chunks of any width >= 1 are served (the engine's width buckets are
+    pow2); None only when no divisor fits."""
+    b = 1 << (C.bit_length() - 1)
+    while b > 1 and (C % b or b * qpk > MAX_PREFILL_ROWS):
+        b //= 2
+    return b if C % b == 0 and b * qpk <= MAX_PREFILL_ROWS else None
+
+
+def ragged_prefill_block(s: int, qpk: int, d: int, page_size: int,
+                         num_slot_pages: int, *,
+                         min_cache: int = 0,
+                         interpret: bool = False) -> Optional[int]:
+    """Static dispatch check for the ragged prefill kernel: returns the
+    q block size (tokens per grid program) or None for the XLA path.
+
+    Same territory rules as the paged decode gate, minus the s == 1
+    restriction it exists to lift: lane-aligned head dim, a page that
+    tiles sublanes (the page IS the K/V DMA unit), TPU-or-interpreter
+    backend, and the SAME per-slot-reach `min_cache` threshold — a
+    decode row served by a mixed step must take the same kernel-vs-XLA
+    path it would take in a decode-scan step on the same pool, or a
+    near-tie argmax could flip mid-stream when admission starts.
+    """
+    if not (interpret or jax.default_backend() == "tpu"):
+        return None
+    if s < 1 or d % 128 != 0:
+        return None
+    if page_size < 16 or page_size % 16 != 0:
+        return None
+    if num_slot_pages * page_size < max(min_cache, 16):
+        return None
+    return _choose_block_q(s, qpk)
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
+                    o_ref, m_scr, l_scr, acc_scr, *, block_q, page_size,
+                    qpk, d, num_pages, sm_scale, split_boundary=True):
+    """Grid (chunk, group, q_block, page); the page dim carries the
+    online-softmax state. Row r of the folded (block_q*qpk, d) q block
+    is chunk token i*block_q + r // qpk (head fastest) at global
+    position starts[c] + token; rows at tokens >= lens[c] are pad."""
+    c = pl.program_id(0)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    rows = block_q * qpk
+    start = starts_ref[c]
+    clen = lens_ref[c]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _accum(masked):
+        qb = q_ref[:].reshape(rows, d)
+        kb = k_ref[:].reshape(page_size, d)
+        sc = jax.lax.dot_general(
+            qb.astype(jnp.float32), kb.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (sm_scale * LOG2E)
+        if masked:
+            # causal + pad mask in one predicate: token t of the chunk
+            # sits at position start + t, may see cols <= start + t, and
+            # is pad when t >= len (pad rows mask EVERY column -> the
+            # finalize clamp emits exact zeros, the empty-slot contract)
+            tok = i * block_q + (
+                jax.lax.broadcasted_iota(jnp.int32, (rows, page_size), 0)
+                // qpk
+            )
+            col = j * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, page_size), 1
+            )
+            # NEG_INF is a finite constant: a PAD row (every column
+            # masked) would degenerate to exp2(0)-everywhere garbage,
+            # so the finalize re-masks pad rows to exact zero; valid
+            # rows always have a real max (page 0, col 0 is causal for
+            # every row), so their masked cells underflow to exact 0.
+            invalid = (col > start + tok) | (tok >= clen)
+            sc = jnp.where(invalid, NEG_INF, sc)
+        m_prev = m_scr[:]  # (rows, 1)
+        m_cur = jnp.max(sc, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(sc - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[:].reshape(page_size, d),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    # last position this q block's VALID rows can attend: the block's
+    # last valid token (or nothing when the block is all pad)
+    blk_last_tok = jnp.minimum((i + 1) * block_q, clen) - 1
+    run = (i * block_q < clen) & \
+        ((j * page_size) <= (start + blk_last_tok))
+    if split_boundary:
+        # maskless when every row is valid AND every column is causal
+        # for even the block's FIRST token
+        interior = ((i + 1) * block_q <= clen) & \
+            ((j * page_size + page_size - 1) <= (start + i * block_q))
+
+        @pl.when(run & interior)
+        def _compute_interior():
+            _accum(False)
+
+        @pl.when(run & ~interior)
+        def _compute_boundary():
+            _accum(True)
+    else:
+        @pl.when(run)
+        def _compute():
+            _accum(True)
+
+    @pl.when(j == num_pages - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        out = acc_scr[:] / l
+        # pad rows accumulated garbage above (see the mask note): pin
+        # them to the exact-zero contract of the XLA twin
+        row_tok = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, d), 0) // qpk
+        out = jnp.where(row_tok < clen, out, 0.0)
+        o_ref[:] = out.astype(o_ref.dtype).reshape(o_ref.shape)
+
+
+def _prefill_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
+                    block_q, interpret):
+    """q: (nc, C, g, qpk, d); k/v_pages: (P, page_size, g, d);
+    page_table: (nc, max_pages) int32; starts/chunk_lens: (nc,) int32.
+    Returns (nc, C, g, qpk, d) in q's dtype (pad rows exact zero)."""
+    nc, C, g, qpk, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    rows = block_q * qpk
+    num_q_blocks = C // block_q
+
+    qf = q.transpose(0, 2, 1, 3, 4).reshape(nc, g, C * qpk, d)
+    # rows below one fp32 sublane tile: launch q/o in fp32 (the small-
+    # memref Mosaic workaround shared with the decode kernels)
+    out_dtype = q.dtype if rows % 8 == 0 else jnp.float32
+    qf = qf.astype(out_dtype)
+
+    kernel = functools.partial(
+        _prefill_kernel, block_q=block_q, page_size=page_size, qpk=qpk,
+        d=d, num_pages=max_pages, sm_scale=1.0 / (d ** 0.5),
+        split_boundary=not interpret,
+    )
+
+    def page_index(c, i, j, starts_ref, lens_ref, pt_ref):
+        # clamp past-the-need page indices to the LAST page this q block
+        # attends (repeated index -> elided DMA): traffic follows
+        # start + len, not the allocated table width. All-pad blocks and
+        # empty chunks clamp to table entry 0 (the slot's null-page
+        # parking by engine convention — always a real, dead page).
+        last_tok = jnp.minimum((i + 1) * block_q,
+                               jnp.maximum(lens_ref[c], 1)) - 1
+        last = jnp.clip((starts_ref[c] + last_tok) // page_size,
+                        0, max_pages - 1)
+        return pt_ref[c, jnp.minimum(j, last)]
+
+    q_spec = pl.BlockSpec(
+        (None, None, rows, d),
+        lambda c, gi, i, j, s_ref, l_ref, pt_ref: (c, gi, i, 0),
+    )
+    kv_spec = pl.BlockSpec(
+        (None, page_size, None, d),
+        lambda c, gi, i, j, s_ref, l_ref, pt_ref: (
+            page_index(c, i, j, s_ref, l_ref, pt_ref), 0, gi, 0
+        ),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nc, g, num_q_blocks, max_pages),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((nc, g, C * qpk, d), out_dtype, qf, k_pages,
+                              v_pages),
+        # (chunk, group, q_block) steps are independent; only the page
+        # dim carries the online-softmax scratch state
+        compiler_params=None if interpret else _compiler_params(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(starts, jnp.int32), jnp.asarray(chunk_lens, jnp.int32),
+      jnp.asarray(page_table, jnp.int32), qf, k_pages, v_pages)
+    return out.reshape(nc, g, C, qpk, d).transpose(0, 2, 1, 3, 4) \
+        .astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (gather pages -> dense ragged-causal math)
+# ---------------------------------------------------------------------------
+
+
+def _xla_ragged_prefill(q, k_pages, v_pages, page_table, starts,
+                        chunk_lens):
+    """Gather each chunk's pages into the dense view, then the
+    `_xla_paged_decode` op sequence generalized to ragged multi-row
+    chunks — the shapes-and-math twin of the kernel, used off-TPU and by
+    the parity tests. Masked columns multiply unwritten pool pages by an
+    exact fp 0; pad rows (token >= chunk_lens) are pinned to the
+    kernel's exact-zero output."""
+    nc, C, g, qpk, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    T = max_pages * page_size
+    k = k_pages[page_table].reshape(nc, T, g, d).transpose(0, 2, 1, 3)
+    v = v_pages[page_table].reshape(nc, T, g, d).transpose(0, 2, 1, 3)
+    qb = q.transpose(0, 2, 1, 3, 4).reshape(nc, g, C * qpk, d)
+    scores = jax.lax.dot_general(
+        qb, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ) * (1.0 / jnp.sqrt(d).astype(jnp.float32))  # (nc, g, C*qpk, T)
+    tok = jnp.arange(C * qpk) // qpk  # (rows,)
+    row_pos = starts[:, None] + tok[None, :]  # (nc, rows)
+    mask = jnp.arange(T)[None, None, :] > row_pos[:, :, None]
+    scores = jnp.where(mask[:, None], jnp.finfo(jnp.float32).min, scores)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jax.lax.dot_general(
+        probs, v, (((3,), (2,)), ((0, 1), (0, 1))),
+    )  # (nc, g, C*qpk, d)
+    row_valid = tok[None, :] < chunk_lens[:, None]  # (nc, rows)
+    out = jnp.where(row_valid[:, None, :, None], out,
+                    jnp.zeros((), out.dtype))
+    return out.reshape(nc, g, C, qpk, d).transpose(0, 2, 1, 3, 4)
+
+
+def scatter_chunk_kv(k_new, v_new, k_pages, v_pages, page_table, starts,
+                     chunk_lens):
+    """Write a chunk's K/V rows into its slot's pages: token t (valid,
+    t < chunk_lens) lands in pool page page_table[c, (starts+t) //
+    page_size] at offset (starts+t) % page_size. Pad rows are routed to
+    pool page 0 — the dead null page every table parks unowned entries
+    on — so they can never touch a live slot's cache. Returns the
+    updated pools."""
+    nc, C = k_new.shape[:2]
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    pos = starts[:, None] + jnp.arange(C)[None, :]  # (nc, C)
+    valid = jnp.arange(C)[None, :] < chunk_lens[:, None]
+    logical = jnp.clip(pos // page_size, 0, max_pages - 1)
+    pages = jnp.where(
+        valid, jnp.take_along_axis(page_table, logical, axis=1), 0)
+    offs = pos % page_size
+    k_pages = k_pages.at[pages, offs].set(k_new)
+    v_pages = v_pages.at[pages, offs].set(v_new)
+    return k_pages, v_pages
+
+
+def ragged_paged_prefill(
+    q: jnp.ndarray,  # (nc, C, g, qpk, d) — C = padded chunk width
+    k_new: jnp.ndarray,  # (nc, C, g, d) — this chunk's K (RoPE applied)
+    v_new: jnp.ndarray,  # (nc, C, g, d)
+    k_pages: jnp.ndarray,  # (num_pages, page_size, g, d)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # (nc, max_pages) int32 pool indices
+    starts: jnp.ndarray,  # (nc,) int32 — chunk start offset in the slot
+    chunk_lens: jnp.ndarray,  # (nc,) int32 valid tokens (<= C; 0 = idle)
+    use_pallas: Optional[bool] = None,
+    min_cache: int = 0,
+    interpret: bool = False,
+):
+    """Ragged paged prefill, one pass: scatter the chunk's own K/V into
+    its slot's pages, then causal attention of chunk token t (global
+    position starts + t) over cache positions 0..starts+t — served by
+    the Pallas kernel on TPU (or under the interpreter) and by the
+    gather-pages twin elsewhere. A decode row is the chunk_lens == 1
+    special case. Returns (out (nc, C, g, qpk, d), k_pages, v_pages);
+    pad rows (t >= chunk_lens) are exact zeros."""
+    nc, C, g, qpk, d = q.shape
+    k_pages, v_pages = scatter_chunk_kv(
+        k_new, v_new, k_pages, v_pages, page_table, starts, chunk_lens)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        bq = ragged_prefill_block(C, qpk, d, k_pages.shape[1],
+                                  page_table.shape[1],
+                                  min_cache=min_cache,
+                                  interpret=interpret)
+        if bq is not None:
+            out = _prefill_pallas(q, k_pages, v_pages, page_table,
+                                  starts, chunk_lens, bq, interpret)
+            return out, k_pages, v_pages
+    out = _xla_ragged_prefill(q, k_pages, v_pages, page_table, starts,
+                              chunk_lens)
+    return out, k_pages, v_pages

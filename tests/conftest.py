@@ -32,6 +32,27 @@ assert len(jax.devices()) == 8, (
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Kernel interpret-mode policy — THE one switch for every Pallas suite
+# (flash, rmsnorm, ring, decode, paged decode, ragged prefill). Off-TPU
+# the real kernels run through the Pallas interpreter so CPU tier-1
+# exercises every kernel; on TPU they compile for real. Override with
+# MEGATRON_TPU_KERNEL_INTERPRET=0/1 (e.g. =1 on TPU to debug a kernel
+# through the interpreter, =0 to skip kernel suites' interpreted runs).
+# ---------------------------------------------------------------------------
+
+
+def kernel_interpret_mode() -> bool:
+    """True -> pass interpret=True (and decode_attn_interpret=True in
+    configs) so the REAL Pallas kernels run under the interpreter; the
+    uniform CPU tier-1 path for every kernel suite. Suites read this
+    ONCE at module import (`from conftest import kernel_interpret_mode`)
+    — one policy, one env var, no per-file hardcoding."""
+    env = os.environ.get("MEGATRON_TPU_KERNEL_INTERPRET")
+    if env is not None:
+        return env.lower() not in ("0", "false", "")
+    return jax.default_backend() != "tpu"
+
 
 @pytest.fixture
 def mesh8():

@@ -1,9 +1,11 @@
 """Flash-attention kernel correctness: forward AND backward vs the XLA
 reference, GQA/MQA/MHA, causal and full (VERDICT r1 missing #4 / weak #3).
 
-Runs the real Pallas kernels through the interpreter on CPU; the same
-kernels compile natively on TPU (driven by bench.py and the on-chip
-numerics check in the verify workflow). Ref parity target: training through
+Interpret mode comes from the ONE shared conftest policy
+(`kernel_interpret_mode` / MEGATRON_TPU_KERNEL_INTERPRET): on CPU the
+real Pallas kernels run through the interpreter; the same kernels
+compile natively on TPU (driven by bench.py and the on-chip numerics
+check in the verify workflow). Ref parity target: training through
 flash-attn (ref transformer.py:508-523) with the external flash_attn
 package's numerics.
 """
@@ -13,11 +15,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import kernel_interpret_mode
 from megatron_llm_tpu.ops.flash_attention import (
     _choose_block,
     _xla_reference,
     flash_attention,
 )
+
+INTERPRET = kernel_interpret_mode()
 
 pytestmark = pytest.mark.slow
 
@@ -32,7 +37,7 @@ def _rand_qkv(b, s, g, qpk, d, dtype=jnp.float32, seed=0):
 
 def _flash_interp(q, k, v, causal=True, block_q=64, block_k=64):
     return flash_attention(
-        q, k, v, causal=causal, use_pallas=True, interpret=True,
+        q, k, v, causal=causal, use_pallas=True, interpret=INTERPRET,
         block_q=block_q, block_k=block_k,
     )
 
@@ -62,7 +67,7 @@ class TestForward:
         q, k, v = _rand_qkv(1, 192, 2, 2, 128)
         ref = _xla_reference(q, k, v, True)
         out = flash_attention(
-            q, k, v, causal=True, use_pallas=True, interpret=True,
+            q, k, v, causal=True, use_pallas=True, interpret=INTERPRET,
             block_q=64, block_k=64,
         )
         np.testing.assert_allclose(
@@ -173,7 +178,7 @@ class TestFlashWithLse:
 
         q, k, v = _rand_qkv(2, 128, 2, 2, 128)
         o1, l1 = flash_attention_with_lse(
-            q, k, v, causal=causal, use_pallas=True, interpret=True,
+            q, k, v, causal=causal, use_pallas=True, interpret=INTERPRET,
             block_q=64, block_k=64,
         )
         o2, l2 = _xla_reference_with_lse(q, k, v, causal)
@@ -199,7 +204,7 @@ class TestFlashWithLse:
             return f
 
         g1 = jax.grad(obj(lambda q, k, v: flash_attention_with_lse(
-            q, k, v, causal=True, use_pallas=True, interpret=True,
+            q, k, v, causal=True, use_pallas=True, interpret=INTERPRET,
             block_q=64, block_k=64)), argnums=(0, 1, 2))(q, k, v)
         g2 = jax.grad(obj(lambda q, k, v: _xla_reference_with_lse(
             q, k, v, True)), argnums=(0, 1, 2))(q, k, v)

@@ -23,12 +23,15 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import kernel_interpret_mode
 from megatron_llm_tpu.ops.decode_attention import (
     _xla_decode,
     _xla_paged_decode,
     paged_decode_attention,
     paged_decode_attn_block,
 )
+
+INTERPRET = kernel_interpret_mode()
 
 
 def _pool_case(slots, g, qpk, d, page_size, pages_per_slot,
@@ -64,7 +67,7 @@ class TestPagedKernel:
                         [64, 1, 63]):
             lengths = jnp.asarray(lengths, jnp.int32)
             out = paged_decode_attention(q, kp, vp, pt, lengths,
-                                         use_pallas=True, interpret=True)
+                                         use_pallas=True, interpret=INTERPRET)
             ref = _xla_paged_decode(q, kp, vp, pt, lengths)
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5,
@@ -79,7 +82,7 @@ class TestPagedKernel:
         q, kp, vp, pt = _pool_case(slots, g, qpk, d, ps, mp, seed=1)
         lengths = jnp.asarray([5, 33, 64], jnp.int32)
         out = paged_decode_attention(q, kp, vp, pt, lengths,
-                                     use_pallas=True, interpret=True)
+                                     use_pallas=True, interpret=INTERPRET)
         kd = kp[pt].reshape(slots, mp * ps, g, d)
         vd = vp[pt].reshape(slots, mp * ps, g, d)
         for i in range(slots):
@@ -96,7 +99,7 @@ class TestPagedKernel:
         for use_pallas in (True, False):
             out = paged_decode_attention(q, kp, vp, pt, lengths,
                                          use_pallas=use_pallas,
-                                         interpret=True)
+                                         interpret=INTERPRET)
             assert not np.any(np.asarray(out[0]))
             assert np.all(np.isfinite(np.asarray(out)))
 
@@ -105,7 +108,7 @@ class TestPagedKernel:
                                    dtype=jnp.bfloat16, seed=3)
         lengths = jnp.asarray([9, 25], jnp.int32)
         out = paged_decode_attention(q, kp, vp, pt, lengths,
-                                     use_pallas=True, interpret=True)
+                                     use_pallas=True, interpret=INTERPRET)
         ref = _xla_paged_decode(q, kp, vp, pt, lengths)
         assert out.dtype == jnp.bfloat16
         np.testing.assert_allclose(
@@ -121,7 +124,7 @@ class TestPagedKernel:
         @jax.jit
         def f(q, kp, vp, pt, lengths):
             return paged_decode_attention(q, kp, vp, pt, lengths,
-                                          use_pallas=True, interpret=True)
+                                          use_pallas=True, interpret=INTERPRET)
 
         for lengths in ([1, 32], [17, 2]):
             lengths = jnp.asarray(lengths, jnp.int32)
@@ -134,6 +137,8 @@ class TestPagedKernel:
 
 class TestPagedDispatch:
     def test_gate(self):
+        # interpret=True HARDCODED: gate-logic test (see
+        # test_decode_attention.TestDispatch.test_gate)
         ok = dict(interpret=True)
         assert paged_decode_attn_block(1, 1, 128, 64, 8, **ok) == 64
         assert paged_decode_attn_block(1, 1, 128, 16, 8, **ok) == 16
@@ -160,7 +165,7 @@ class TestPagedDispatch:
         q, kp, vp, pt = _pool_case(slots, g, qpk, d, ps, mp, seed=5)
         lengths = jnp.asarray([3, 20], jnp.int32)
         out = paged_decode_attention(q, kp, vp, pt, lengths,
-                                     use_pallas=True, interpret=True)
+                                     use_pallas=True, interpret=INTERPRET)
         np.testing.assert_array_equal(
             np.asarray(out),
             np.asarray(_xla_paged_decode(q, kp, vp, pt, lengths)),
@@ -181,7 +186,7 @@ class TestAttentionBlockPaged:
             max_position_embeddings=64, seq_length=64,
             compute_dtype=jnp.float32, params_dtype=jnp.float32,
             use_bias=False, attention_dropout=0.0, hidden_dropout=0.0,
-            use_decode_attn=True, decode_attn_interpret=True,
+            use_decode_attn=True, decode_attn_interpret=INTERPRET,
             decode_attn_min_cache=0,
         )
         base.update(over)

@@ -22,6 +22,7 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from conftest import kernel_interpret_mode
 from megatron_llm_tpu.config import ParallelConfig, TrainConfig, tiny_config
 from megatron_llm_tpu.models import LlamaModel
 from megatron_llm_tpu.parallel.mesh import (
@@ -326,7 +327,7 @@ class TestPipelinedDecode:
         ref, toks, lens, lps = self._run(
             pp=2, max_len=40,
             cfg_over=dict(kv_channels=128, use_decode_attn=True,
-                          decode_attn_interpret=True,
+                          decode_attn_interpret=kernel_interpret_mode(),
                           decode_attn_min_cache=0),
         )
         np.testing.assert_array_equal(np.asarray(ref.tokens),

@@ -1,0 +1,379 @@
+"""Ragged paged prefill correctness (ISSUE 4 tentpole, kernel layer).
+
+Kernel runs go through the REAL Pallas kernel via the shared interpret
+policy (conftest.kernel_interpret_mode — the interpreter on CPU).
+Pinned here:
+
+- kernel vs the gather-pages XLA twin across ragged chunk lengths x
+  start offsets x partial pages x MHA/GQA/MQA and bf16, including
+  chunks that start/end mid-page and empty (length-0) chunks;
+- the scatter-then-attend contract: the chunk's own K/V is readable by
+  the chunk's causal columns in the same pass, pad rows land on the
+  null page only, and a chunk equals the dense causal forward on the
+  gathered view;
+- decode-row degeneracy: a width-1 chunk reproduces the paged decode
+  kernel's output for the same slot state;
+- the static dispatch gate (lane alignment, page tiling, width blocks,
+  backend/interpret);
+- attention_block's chunked paged branch: kernel on vs XLA fallback
+  parity, ragged length advance, and parity of a chunked pass vs the
+  dense prefill path at the layer level;
+- transformer_stack plumbing: chunk_lens rides to every layer and the
+  stack-level lengths advance is ragged.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import kernel_interpret_mode
+from megatron_llm_tpu.ops.decode_attention import paged_decode_attention
+from megatron_llm_tpu.ops.prefill_attention import (
+    _xla_ragged_prefill,
+    ragged_paged_prefill,
+    ragged_prefill_block,
+    scatter_chunk_kv,
+)
+
+INTERPRET = kernel_interpret_mode()
+
+
+def _pool_case(nc, C, g, qpk, d, page_size, pages_per_slot, dtype=jnp.float32,
+               seed=0):
+    """Random chunk batch + pool + a page table of distinct shuffled
+    pages per chunk (page 0 reserved as null)."""
+    num_pages = 1 + nc * pages_per_slot
+    ks = jax.random.split(jax.random.key(seed), 5)
+    q = jax.random.normal(ks[0], (nc, C, g, qpk, d), dtype)
+    k_new = jax.random.normal(ks[1], (nc, C, g, d), dtype)
+    v_new = jax.random.normal(ks[2], (nc, C, g, d), dtype)
+    kp = jax.random.normal(ks[3], (num_pages, page_size, g, d), dtype)
+    vp = jax.random.normal(ks[4], (num_pages, page_size, g, d), dtype)
+    rs = np.random.RandomState(seed)
+    perm = rs.permutation(num_pages - 1) + 1
+    pt = jnp.asarray(perm.reshape(nc, pages_per_slot), jnp.int32)
+    return q, k_new, v_new, kp, vp, pt
+
+
+CASES = [
+    pytest.param(4, 1, id="mha"),
+    pytest.param(2, 2, id="gqa"),
+    pytest.param(1, 8, id="mqa"),
+]
+
+
+def _both(q, kn, vn, kp, vp, pt, starts, lens):
+    starts = jnp.asarray(starts, jnp.int32)
+    lens = jnp.asarray(lens, jnp.int32)
+    out_k, kpk, vpk = ragged_paged_prefill(
+        q, kn, vn, kp, vp, pt, starts, lens,
+        use_pallas=True, interpret=INTERPRET)
+    kpx, vpx = scatter_chunk_kv(kn, vn, kp, vp, pt, starts, lens)
+    out_x = _xla_ragged_prefill(q, kpx, vpx, pt, starts, lens)
+    return out_k, out_x, (kpk, vpk), (kpx, vpx)
+
+
+class TestRaggedPrefillKernel:
+    @pytest.mark.parametrize("g,qpk", CASES)
+    def test_matches_xla_across_offsets_and_lengths(self, g, qpk):
+        """Chunk starts at page starts, page ends, mid-page; lengths
+        full, ragged, and straddling page boundaries — every
+        combination in ONE launch must match the gathered twin."""
+        q, kn, vn, kp, vp, pt = _pool_case(3, 8, g, qpk, 128, 16, 4)
+        for starts, lens in (([0, 13, 30], [8, 8, 8]),
+                             ([5, 16, 47], [3, 8, 1]),
+                             ([8, 31, 56], [6, 2, 8]),
+                             ([0, 24, 40], [1, 7, 5])):
+            out_k, out_x, pools_k, pools_x = _both(
+                q, kn, vn, kp, vp, pt, starts, lens)
+            np.testing.assert_allclose(
+                np.asarray(out_k), np.asarray(out_x), rtol=1e-5,
+                atol=1e-5, err_msg=f"{starts}/{lens}")
+            for a, b in zip(pools_k, pools_x):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+
+    def test_empty_and_pad_chunks_are_exact_zero(self):
+        """Length-0 chunks (idle slots of a mixed step) and the pad
+        rows of ragged chunks return exact zeros on both paths, and
+        their K/V lands on the null page only."""
+        q, kn, vn, kp, vp, pt = _pool_case(2, 8, 2, 1, 128, 16, 2,
+                                           seed=1)
+        starts, lens = [0, 9], [0, 3]
+        out_k, out_x, (kpk, _), _ = _both(q, kn, vn, kp, vp, pt, starts,
+                                          lens)
+        for out in (out_k, out_x):
+            assert not np.any(np.asarray(out[0]))  # empty chunk
+            assert not np.any(np.asarray(out[1, 3:]))  # pad rows
+            assert np.all(np.isfinite(np.asarray(out)))
+        # pad/idle K/V never touches a live page: only the null page
+        # and chunk 1's written positions may differ from the original
+        before = np.asarray(kp)
+        after = np.asarray(kpk)
+        changed = {int(p) for p in np.argwhere(
+            np.any(after != before, axis=(1, 2, 3)))[:, 0]}
+        live = {int(np.asarray(pt)[1, (9 + t) // 16]) for t in range(3)}
+        assert changed <= ({0} | live)
+
+    def test_chunk_reads_its_own_kv(self):
+        """Causal columns INSIDE the chunk span come from the K/V
+        scattered in the same pass: attending with start=0 over a pool
+        that held garbage in the span's pages must equal dense causal
+        attention over k_new/v_new alone."""
+        nc, C, g, qpk, d = 1, 8, 2, 2, 128
+        q, kn, vn, kp, vp, pt = _pool_case(nc, C, g, qpk, d, 16, 2,
+                                           seed=2)
+        out_k, out_x, _, _ = _both(q, kn, vn, kp, vp, pt, [0], [C])
+        # dense causal reference on the raw chunk K/V
+        from megatron_llm_tpu.models.attention import (
+            causal_mask,
+            grouped_attention,
+        )
+
+        class _Cfg:
+            attention_dropout = 0.0
+            num_query_groups, q_per_kv, head_dim = g, qpk, d
+
+        ref = grouped_attention(q, kn, vn, causal_mask(C), _Cfg(),
+                                None, True)
+        for out in (out_k, out_x):
+            np.testing.assert_allclose(
+                np.asarray(out).reshape(nc, C, -1), np.asarray(ref),
+                rtol=1e-5, atol=1e-5)
+
+    def test_width_one_chunk_equals_paged_decode(self):
+        """A chunk of width 1 at offset `length` IS a decode row: the
+        ragged prefill path must reproduce paged_decode_attention for
+        the same slot state (the mixed step's decode rows ride the
+        prefill kernel)."""
+        slots, g, qpk, d, ps, mp = 2, 2, 2, 128, 16, 4
+        q, kn, vn, kp, vp, pt = _pool_case(slots, 1, g, qpk, d, ps, mp,
+                                           seed=3)
+        lengths = jnp.asarray([7, 33], jnp.int32)
+        out, kpn, vpn = ragged_paged_prefill(
+            q, kn, vn, kp, vp, pt, lengths, jnp.asarray([1, 1]),
+            use_pallas=True, interpret=INTERPRET)
+        ref = paged_decode_attention(
+            q, kpn, vpn, pt, lengths + 1, use_pallas=True,
+            interpret=INTERPRET)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bf16_close(self):
+        q, kn, vn, kp, vp, pt = _pool_case(2, 8, 2, 2, 128, 16, 2,
+                                           dtype=jnp.bfloat16, seed=4)
+        out_k, out_x, _, _ = _both(q, kn, vn, kp, vp, pt, [0, 17],
+                                   [8, 5])
+        assert out_k.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out_k, np.float32), np.asarray(out_x, np.float32),
+            rtol=2e-2, atol=2e-2)
+
+    def test_traced_operands_under_jit(self):
+        """starts/lens/page table are TRACED in the engine's mixed
+        step; the scalar-prefetch operands must accept them."""
+        q, kn, vn, kp, vp, pt = _pool_case(2, 4, 2, 1, 128, 16, 2,
+                                           seed=5)
+
+        @jax.jit
+        def f(q, kn, vn, kp, vp, pt, starts, lens):
+            return ragged_paged_prefill(q, kn, vn, kp, vp, pt, starts,
+                                        lens, use_pallas=True,
+                                        interpret=INTERPRET)[0]
+
+        for starts, lens in (([0, 8], [4, 4]), ([3, 15], [2, 4])):
+            starts = jnp.asarray(starts, jnp.int32)
+            lens = jnp.asarray(lens, jnp.int32)
+            kpx, vpx = scatter_chunk_kv(kn, vn, kp, vp, pt, starts,
+                                        lens)
+            np.testing.assert_allclose(
+                np.asarray(f(q, kn, vn, kp, vp, pt, starts, lens)),
+                np.asarray(_xla_ragged_prefill(q, kpx, vpx, pt, starts,
+                                               lens)),
+                rtol=1e-5, atol=1e-5)
+
+
+class TestPrefillDispatch:
+    def test_gate(self):
+        ok = dict(interpret=True)
+        assert ragged_prefill_block(8, 1, 128, 16, 4, **ok) == 8
+        assert ragged_prefill_block(1, 8, 128, 16, 4, **ok) == 1
+        assert ragged_prefill_block(256, 1, 128, 64, 8, **ok) == 256
+        # wide GQA folds shrink the q block under the VMEM row cap
+        assert ragged_prefill_block(2048, 8, 128, 16, 4, **ok) == 256
+        # lane alignment
+        assert ragged_prefill_block(8, 1, 64, 16, 4, **ok) is None
+        # page must tile sublanes
+        assert ragged_prefill_block(8, 1, 128, 8, 4, **ok) is None
+        assert ragged_prefill_block(8, 1, 128, 24, 4, **ok) is None
+        # min-cache threshold measured against the per-slot reach, the
+        # SAME rule as the paged decode gate: a decode row must take
+        # the same kernel-vs-XLA path in mixed and scan steps
+        assert ragged_prefill_block(8, 1, 128, 16, 4, min_cache=128,
+                                    interpret=True) is None
+        assert ragged_prefill_block(8, 1, 128, 16, 8, min_cache=128,
+                                    interpret=True) == 8
+        if jax.default_backend() != "tpu":
+            assert ragged_prefill_block(8, 1, 128, 16, 4,
+                                        interpret=False) is None
+
+    def test_ineligible_page_size_falls_back(self):
+        q, kn, vn, kp, vp, pt = _pool_case(2, 4, 2, 1, 128, 8, 4,
+                                           seed=6)
+        starts = jnp.asarray([0, 5], jnp.int32)
+        lens = jnp.asarray([4, 3], jnp.int32)
+        out, kpn, vpn = ragged_paged_prefill(
+            q, kn, vn, kp, vp, pt, starts, lens, use_pallas=True,
+            interpret=INTERPRET)
+        np.testing.assert_array_equal(
+            np.asarray(out),
+            np.asarray(_xla_ragged_prefill(q, kpn, vpn, pt, starts,
+                                           lens)))
+
+
+class TestAttentionBlockChunked:
+    """attention_block's chunked paged branch: kernel vs XLA parity,
+    the ragged length advance, and chunked == dense prefill at the
+    layer level."""
+
+    def _cfg(self, **over):
+        from megatron_llm_tpu.config import ModelConfig
+
+        base = dict(
+            num_layers=1, hidden_size=256, num_attention_heads=2,
+            num_attention_heads_kv=1, kv_channels=128,
+            max_position_embeddings=64, seq_length=64,
+            compute_dtype=jnp.float32, params_dtype=jnp.float32,
+            use_bias=False, attention_dropout=0.0, hidden_dropout=0.0,
+            use_decode_attn=True, decode_attn_interpret=INTERPRET,
+            decode_attn_min_cache=0,
+        )
+        base.update(over)
+        return ModelConfig(**base)
+
+    def _params(self, cfg, seed=0):
+        ks = jax.random.split(jax.random.key(seed), 2)
+        h = cfg.hidden_size
+        return {
+            "wqkv": jax.random.normal(
+                ks[0], (h, cfg.qkv_projection_size), jnp.float32) * 0.05,
+            "wo": jax.random.normal(
+                ks[1], (cfg.num_attention_heads * cfg.head_dim, h),
+                jnp.float32) * 0.05,
+        }
+
+    def _cache(self, cfg, slots, ps, mp, lengths, chunk_lens, seed=6):
+        g, d = cfg.num_query_groups, cfg.head_dim
+        num_pages = 1 + slots * mp
+        pt = np.zeros((slots, mp), np.int32)
+        for i in range(slots):
+            pt[i] = np.arange(1 + i * mp, 1 + (i + 1) * mp)
+        return {
+            "k_pages": jnp.zeros((num_pages, ps, g, d), jnp.float32),
+            "v_pages": jnp.zeros((num_pages, ps, g, d), jnp.float32),
+            "page_table": jnp.asarray(pt),
+            "lengths": jnp.asarray(lengths, jnp.int32),
+            "chunk_lens": jnp.asarray(chunk_lens, jnp.int32),
+        }
+
+    def test_kernel_vs_xla_and_length_advance(self):
+        from megatron_llm_tpu.models.attention import attention_block
+
+        cfg_on = self._cfg()
+        cfg_off = dataclasses.replace(cfg_on, use_decode_attn=False)
+        params = self._params(cfg_on)
+        slots, ps, mp, w = 2, 16, 4, 8
+        hidden = jax.random.normal(jax.random.key(5), (slots, w, 256),
+                                   jnp.float32)
+        outs = {}
+        for name, cfg in (("on", cfg_on), ("off", cfg_off)):
+            outs[name] = attention_block(
+                params, cfg, hidden, None, None, None,
+                kv_cache=self._cache(cfg, slots, ps, mp, [0, 21],
+                                     [8, 3]))
+        np.testing.assert_allclose(
+            np.asarray(outs["on"][0]), np.asarray(outs["off"][0]),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(outs["on"][1]["lengths"]), [8, 24])
+        for key in ("k_pages", "v_pages"):
+            np.testing.assert_array_equal(
+                np.asarray(outs["on"][1][key]),
+                np.asarray(outs["off"][1][key]))
+
+    def test_chunked_equals_dense_prefill_per_layer(self):
+        """Feeding a prompt through the chunked branch in two ragged
+        spans reproduces the dense per-layer prefill — the layer-level
+        form of the engine's exact-match guarantee. Numerically tight
+        (not bitwise) HERE: at this width XLA's CPU thread partitioning
+        blocks the h-reduction differently per matmul M-dim; the
+        BITWISE pin lives at the engine level (tests/test_engine.py),
+        where it holds across chunk placements."""
+        from megatron_llm_tpu.models.attention import attention_block
+        from megatron_llm_tpu.models.rope import precompute_rope
+
+        cfg = self._cfg(use_decode_attn=False)
+        params = self._params(cfg)
+        rope = precompute_rope(cfg.head_dim, 64, cfg.rope_theta, 1.0)
+        s = 11
+        hidden = jax.random.normal(jax.random.key(8), (1, s, 256),
+                                   jnp.float32)
+        # dense prefill: per-layer standalone cache, one causal forward
+        dense_cache = {
+            "k": jnp.zeros((1, 16, cfg.num_query_groups, cfg.head_dim)),
+            "v": jnp.zeros((1, 16, cfg.num_query_groups, cfg.head_dim)),
+            "offset": jnp.array(0, jnp.int32),
+        }
+        ref, _ = attention_block(params, cfg, hidden, rope, None, None,
+                                 kv_cache=dense_cache)
+        got = np.zeros_like(np.asarray(ref))
+        cache = self._cache(cfg, 1, 16, 2, [0], [0])
+        for a, b in ((0, 7), (7, 11)):
+            w = 8
+            h_c = jnp.zeros((1, w, 256), jnp.float32)
+            h_c = h_c.at[:, :b - a].set(hidden[:, a:b])
+            cache["chunk_lens"] = jnp.asarray([b - a], jnp.int32)
+            out, cache = attention_block(params, cfg, h_c, rope, None,
+                                         None, kv_cache=cache)
+            got[:, a:b] = np.asarray(out[:, :b - a])
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5,
+                                   atol=5e-6)
+
+
+def test_transformer_stack_chunk_plumbing():
+    """chunk_lens rides through the unrolled paged stack to every
+    layer, the stack-level lengths advance is ragged, and the result
+    matches the same stack fed slot-by-slot."""
+    from megatron_llm_tpu.config import tiny_config
+    from megatron_llm_tpu.models import LlamaModel
+
+    cfg = tiny_config(compute_dtype=jnp.float32, use_decode_attn=False)
+    model = LlamaModel(cfg)
+    params = model.prepare_decode_params(model.init(jax.random.key(0)))
+    slots, ps, mp, w = 2, 16, 2, 4
+    caches = model.init_paged_kv_caches(slots, 1 + slots * mp, ps, mp)
+    pt = np.zeros((slots, mp), np.int32)
+    for i in range(slots):
+        pt[i] = np.arange(1 + i * mp, 1 + (i + 1) * mp)
+    toks = jnp.asarray(np.arange(2, 2 + slots * w).reshape(slots, w))
+    lengths = jnp.asarray([0, 5], jnp.int32)
+    chunk_lens = jnp.asarray([4, 2], jnp.int32)
+    kvc = dict(caches, page_table=jnp.asarray(pt), lengths=lengths,
+               chunk_lens=chunk_lens)
+    pos = lengths[:, None] + jnp.arange(w)[None, :]
+    logits, out_c = model.forward(params, toks, kv_caches=kvc,
+                                  position_ids=pos)
+    np.testing.assert_array_equal(np.asarray(out_c["lengths"]), [4, 7])
+    assert len(out_c["k_pages_layers"]) == cfg.num_layers
+    # slot 0 alone through its own single-slot stack: identical logits
+    solo = model.init_paged_kv_caches(1, 1 + mp, ps, mp)
+    solo = dict(solo, page_table=jnp.asarray(pt[:1] - 0), lengths=lengths[:1],
+                chunk_lens=chunk_lens[:1])
+    solo["page_table"] = jnp.asarray(np.arange(1, 1 + mp)[None])
+    logits_solo, _ = model.forward(params, toks[:1], kv_caches=solo,
+                                   position_ids=pos[:1])
+    np.testing.assert_array_equal(np.asarray(logits[0, :4]),
+                                  np.asarray(logits_solo[0, :4]))

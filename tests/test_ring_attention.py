@@ -12,10 +12,13 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
+from conftest import kernel_interpret_mode
 from megatron_llm_tpu.models.attention import causal_mask, grouped_attention
 from megatron_llm_tpu.parallel.ring_attention import make_ring_attention
 
 pytestmark = pytest.mark.slow
+
+INTERPRET = kernel_interpret_mode()
 
 
 class _Cfg:
@@ -106,7 +109,7 @@ def test_ring_with_real_kernel_interpreted(cp, causal):
     v = jax.random.normal(kv, (b, S, g, d), jnp.float32)
 
     ring = make_ring_attention(_mesh(cp), "cp", causal=causal,
-                               use_pallas=True, interpret=True)
+                               use_pallas=True, interpret=INTERPRET)
     got = np.asarray(jax.jit(ring)(q, k, v))
     want = np.asarray(_ref(q, k, v, causal))
     np.testing.assert_allclose(got, want, atol=5e-5, rtol=1e-4)

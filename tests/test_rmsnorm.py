@@ -1,8 +1,10 @@
 """Fused RMSNorm Pallas kernel vs the XLA reference (fwd + grads).
 
 Kernel under test: ops/rmsnorm.py (ref analogue: apex fused layer norm,
-fused_layer_norm.py:64-139). CPU suite runs the real kernel through the
-Pallas interpreter, same pattern as tests/test_flash_attention.py.
+fused_layer_norm.py:64-139). Interpret mode comes from the ONE shared
+conftest policy (`kernel_interpret_mode` / MEGATRON_TPU_KERNEL_INTERPRET):
+off-TPU the real kernel runs through the Pallas interpreter — the
+uniform CPU tier-1 path for every kernel suite.
 """
 
 import jax
@@ -10,12 +12,15 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import kernel_interpret_mode
 from megatron_llm_tpu.models.norms import rms_norm
 from megatron_llm_tpu.ops.rmsnorm import fused_rms_norm
 
+INTERPRET = kernel_interpret_mode()
+
 
 def _run(x, s, eps=1e-6):
-    return fused_rms_norm(x, s, eps, use_pallas=True, interpret=True)
+    return fused_rms_norm(x, s, eps, use_pallas=True, interpret=INTERPRET)
 
 
 @pytest.mark.parametrize("shape,dtype", [
@@ -59,6 +64,6 @@ def test_unaligned_hidden_falls_back():
     # h not a multiple of 128 silently uses the XLA path
     x = jax.random.normal(jax.random.key(2), (4, 100), jnp.float32)
     s = jnp.ones((100,), jnp.float32)
-    got = np.asarray(fused_rms_norm(x, s, use_pallas=True, interpret=True))
+    got = np.asarray(fused_rms_norm(x, s, use_pallas=True, interpret=INTERPRET))
     want = np.asarray(rms_norm(x, s))
     np.testing.assert_allclose(got, want, atol=1e-6)

@@ -298,6 +298,30 @@ class TestEndToEnd:
                 })[1]["text"]
             assert body["text"] == solo[p]
 
+    def test_metrics_endpoint_serves_engine_counters(self,
+                                                     served_engine):
+        """GET /metrics returns the live DecodeEngine.counters() dict —
+        occupancy/queue/pages/tok_s plus the ISSUE-4 latency gauges —
+        as JSON (the HTTP surface of the timers-gauge schema)."""
+        _, _, _, engine, port = served_engine
+        # ensure at least one request has flowed so the gauges are live
+        status, _, _ = _put(port, {
+            "prompts": ["hi"], "tokens_to_generate": 2, "top_k": 1,
+        })
+        assert status == 200
+        conn = HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = json.loads(resp.read().decode())
+        conn.close()
+        assert resp.status == 200
+        for key in ("serve_slot_occupancy", "serve_queue_depth",
+                    "serve_pages_in_use", "serve_tok_s",
+                    "serve_ttft_p50_ms", "serve_ttft_p95_ms",
+                    "serve_decode_p95_ms", "serve_prefill_tokens"):
+            assert key in body, key
+        assert body["serve_ttft_p50_ms"] > 0
+
     def test_per_request_knobs_ride_along(self, served_engine):
         """Sampled request with seed: deterministic across resubmission
         (engine RNG is per-request), tokens_to_generate honored."""

@@ -44,6 +44,18 @@ def main():
     p.add_argument("--step_horizon", type=int, default=8,
                    help="decode steps per host round-trip (dispatch "
                         "amortizer; admission latency quantum)")
+    p.add_argument("--prefill_chunk_tokens", type=int, default=256,
+                   help="per-round prompt-token budget of chunked "
+                        "admission (mixed prefill+decode steps): a long "
+                        "prompt delays each in-flight decode token by at "
+                        "most one chunk forward; 0 = whole-prompt "
+                        "prefill at admission (single-tenant short-"
+                        "prompt mode)")
+    p.add_argument("--warmup_compile", action="store_true",
+                   help="pre-trace the mixed-step/decode-scan "
+                        "executables for the configured buckets before "
+                        "serving, so the first request never eats the "
+                        "compile stall")
     args = p.parse_args()
 
     import jax
@@ -105,13 +117,19 @@ def main():
             page_size=args.page_size, max_context=args.max_context,
             page_budget=args.page_budget, max_queue=args.max_queue,
             step_horizon=args.step_horizon,
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
+            warmup_compile=args.warmup_compile,
             termination_id=tokenizer.eod,
             vocab_size=tokenizer.vocab_size,
         )
     print(f"serving {args.model} from {path} on "
           f"http://{args.host}:{args.port}/api"
           + (f" (continuous batching: {args.serving_slots} slots, "
-             f"{engine.num_pages - 1} pages x {args.page_size})"
+             f"{engine.num_pages - 1} pages x {args.page_size}, "
+             + (f"chunked prefill {engine.prefill_chunk_tokens} tok/round"
+                if engine.prefill_chunk_tokens else
+                "whole-prompt prefill")
+             + ", counters at /metrics)"
              if engine else " (whole-batch, no engine)"), flush=True)
     MegatronServer(model, params, tokenizer, engine=engine).run(
         args.host, args.port)
