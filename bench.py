@@ -39,6 +39,14 @@ per-round decode-latency p95 for both, `chunked_vs_wholeprompt_ttft`
 as the headline ratio, per-round prefill-token maxima as the budget
 audit, methodology stated in-row.
 
+Round-10 audit keys (ISSUE 5): `extra.ckpt` measures the
+fault-tolerance claim — train-loop stall per checkpoint under the async
+CheckpointManager (device→host copy only) vs the synchronous
+save-and-commit wall time, at the bench model size with real fp32
+master params + Adam m/v; the row asserts the async checkpoint restores
+bitwise and that keep_latest_n retention GC holds, and states its
+methodology in-row.
+
 Methodology: the reference's in-repo anchor is the Llama-2-7B fine-tune at
 ~890 tokens/sec/GPU on A100-80GB (BASELINE.md; docs/guide/getting_started.md
 :195-201). A 7B model does not fit on the single 16GB v5e chip available
@@ -483,6 +491,98 @@ def run_serving(n_requests=16, slots=8):
     return stats
 
 
+def ckpt_stall_stats(model_cfg, params, opt_state, base_dir, n_saves=3):
+    """Sync-vs-async checkpoint stall (ISSUE 5): how long the train loop
+    is BLOCKED per checkpoint with the synchronous path (full
+    write-and-commit wall time) vs the CheckpointManager async path
+    (device→host copy only; commits land on a background thread between
+    save intervals — each measured save first waits out the previous
+    commit OFF the clock, exactly like a real save_interval's worth of
+    compute would). Also exercises keep_latest_n GC and certifies the
+    async checkpoint restores byte-identically. CPU-testable harness:
+    bench calls it with the bench model, tests with a tiny one
+    (tests/test_fault_tolerance.py)."""
+    import os
+    import shutil
+
+    import numpy as np
+
+    from megatron_llm_tpu.training.checkpointing import (
+        CheckpointManager,
+        is_checkpoint_complete,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    ckpt_bytes = sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for tree in (params, opt_state.m, opt_state.v)
+        if tree is not None
+        for l in jax.tree.leaves(tree)
+    )
+    sync_dir = os.path.join(base_dir, "sync")
+    async_dir = os.path.join(base_dir, "async")
+    try:
+        t0 = time.perf_counter()
+        save_checkpoint(sync_dir, 1, params, opt_state, model_cfg)
+        sync_ms = (time.perf_counter() - t0) * 1e3
+
+        mgr = CheckpointManager(async_dir, keep_latest_n=1)
+        blocked = []
+        for i in range(1, n_saves + 1):
+            mgr.save(i, params, opt_state, model_cfg)
+            blocked.append(mgr.last_blocked_ms)
+            # the commit finishes during the next save_interval's
+            # compute in a real run: wait it out off the clock
+            mgr.wait_until_finished()
+        async_blocked_ms = sorted(blocked)[len(blocked) // 2]
+        last = os.path.join(async_dir, f"iter_{n_saves:07d}")
+        assert is_checkpoint_complete(last), last
+        restored = load_checkpoint(async_dir, params, opt_state, model_cfg)
+        assert restored is not None and restored[3] == n_saves
+        for a, b in zip(jax.tree.leaves(params),
+                        jax.tree.leaves(restored[0])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # keep_latest_n=1 GC: only the newest iter dir survives
+        survivors = [d for d in os.listdir(async_dir)
+                     if d.startswith("iter_")]
+        assert survivors == [f"iter_{n_saves:07d}"], survivors
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+    return {
+        "ckpt_bytes": ckpt_bytes,
+        "sync_save_ms": round(sync_ms, 1),
+        "async_blocked_ms": round(async_blocked_ms, 1),
+        "async_vs_sync_stall": round(async_blocked_ms / sync_ms, 4),
+        "sync_save_mb_s": round(ckpt_bytes / 1e6 / (sync_ms / 1e3), 1),
+        "async_restore_bitwise": True,
+        "methodology": (
+            "one full params+optimizer checkpoint of the bench model; "
+            "sync = save_checkpoint wall (write+commit+sentinel); async "
+            "= CheckpointManager.save blocked ms (median of "
+            f"{n_saves}, device→host copy only; each save's commit "
+            "waited out off the clock, as a save_interval of compute "
+            "would); restore asserted bitwise; keep_latest_n=1 GC "
+            "asserted"
+        ),
+    }
+
+
+def run_ckpt_bench():
+    """bench-model fault-tolerance row: the ckpt_blocked_ms claim
+    (async save stall < 25% of sync save wall, ISSUE 5 acceptance)
+    measured at the bench model size with real fp32 master params +
+    Adam m/v."""
+    import tempfile
+
+    cfg = make_cfg(1024)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    opt_state = init_optimizer_state(params, TrainConfig())
+    base = tempfile.mkdtemp(prefix="bench_ckpt_")
+    return ckpt_stall_stats(cfg, params, opt_state, base, n_saves=3)
+
+
 def _timed_scan(f, operands, n=20):
     """Median-free best-of-2 of an n-deep jitted scan over `f`; returns
     seconds per call. The carry threads a zero-scaled output back into
@@ -720,6 +820,7 @@ def main():
     attn_stats = decode_attn_op_stats(b=8, T=64 + gen)
     mxu = flash_mxu_stats()
     serving = run_serving()
+    ckpt = run_ckpt_bench()
     achieved = tok1 * 6 * n_params
     baseline = 890.0 * 6 * 7.0e9  # A100 anchor, BASELINE.md
     print(json.dumps({
@@ -749,7 +850,11 @@ def main():
             f"vs whole-prompt (decode p95 "
             f"{serving['interference']['chunked']['decode_p95_ms']} vs "
             f"{serving['interference']['wholeprompt']['decode_p95_ms']}"
-            f" ms)"
+            f" ms); async ckpt blocks the loop "
+            f"{ckpt['async_blocked_ms']:.0f}ms = "
+            f"{ckpt['async_vs_sync_stall']:.0%} of the "
+            f"{ckpt['sync_save_ms']:.0f}ms sync save "
+            f"({ckpt['ckpt_bytes'] / 1e9:.1f}GB, restore bitwise)"
         ),
         "value": round(tok1, 1),
         "unit": "tokens/sec/chip",
@@ -774,6 +879,7 @@ def main():
             **attn_stats,
             "decode_step_breakdown_b8": breakdown,
             "serving": serving,
+            "ckpt": ckpt,
         },
     }))
 

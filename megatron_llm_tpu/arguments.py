@@ -337,6 +337,25 @@ def build_base_parser() -> argparse.ArgumentParser:
     g.add_argument("--no_save_optim", action="store_true")
     g.add_argument("--no_load_optim", action="store_true")
     g.add_argument("--no_load_rng", action="store_true")
+    # fault tolerance (ISSUE 5, training/checkpointing.py CheckpointManager
+    # + training/watchdog.py; docs/GUIDE.md "Fault tolerance")
+    g.add_argument("--no_async_save", dest="async_save",
+                   action="store_false", default=True,
+                   help="block the train loop until each checkpoint is "
+                        "fully committed (default: async — the loop only "
+                        "pays the device→host copy)")
+    g.add_argument("--keep_latest_n", type=int, default=None,
+                   help="retention GC: keep only the newest N complete "
+                        "checkpoints (default: keep everything)")
+    g.add_argument("--loss_watchdog_ksigma", type=float, default=0.0,
+                   help="skip optimizer updates whose loss exceeds "
+                        "median + k*sigma of the recent-loss window "
+                        "(robust MAD sigma); 0 disables spike detection")
+    g.add_argument("--loss_watchdog_window", type=int, default=64)
+    g.add_argument("--spike_rollback_patience", type=int, default=0,
+                   help="after N consecutive bad steps, reload the last "
+                        "complete checkpoint and fast-forward the data "
+                        "iterator past the poison window; 0 disables")
 
     g = p.add_argument_group("mixed precision")  # ref :783-815
     g.add_argument("--fp16", action="store_true")
@@ -596,6 +615,11 @@ def args_to_configs(args, padded_vocab_size: int):
         no_save_optim=args.no_save_optim,
         no_load_optim=args.no_load_optim,
         no_load_rng=args.no_load_rng,
+        async_save=args.async_save,
+        keep_latest_n=args.keep_latest_n,
+        loss_watchdog_ksigma=args.loss_watchdog_ksigma,
+        loss_watchdog_window=args.loss_watchdog_window,
+        spike_rollback_patience=args.spike_rollback_patience,
         log_interval=args.log_interval,
         eval_interval=args.eval_interval,
         eval_iters=args.eval_iters,

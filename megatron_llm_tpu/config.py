@@ -396,6 +396,27 @@ class TrainConfig:
     no_save_optim: bool = False
     no_load_optim: bool = False
     no_load_rng: bool = False
+    # Fault tolerance (ISSUE 5, training/checkpointing.py +
+    # training/watchdog.py):
+    # async_save: interval saves go through the CheckpointManager's
+    # orbax-async path — the train loop stalls only for the device→host
+    # copy (the `ckpt_blocked_ms` gauge), commits finish on a background
+    # thread, wait-at-exit only. --no_async_save restores blocking saves.
+    async_save: bool = True
+    # retention: keep the newest N COMPLETE checkpoints, GC the rest
+    # (never the one being written or the one resume read). None = keep
+    # everything.
+    keep_latest_n: Optional[int] = None
+    # loss watchdog: a step whose loss is non-finite or above
+    # median + ksigma * robust-sigma of the recent-loss window is
+    # SKIPPED in-step (the fp16 scaler's skip machinery, for bf16 too);
+    # ksigma <= 0 disables spike detection (NaN/inf losses still skip).
+    loss_watchdog_ksigma: float = 0.0
+    loss_watchdog_window: int = 64
+    # after this many CONSECUTIVE bad steps, reload the last complete
+    # checkpoint and fast-forward the data iterator past the poison
+    # window; 0 disables rollback (skip-only).
+    spike_rollback_patience: int = 0
 
     # Logging / eval (ref: arguments.py:477-541, 870-877)
     log_interval: int = 100

@@ -153,21 +153,36 @@ class EngineRequest:
     seed: int = 0
     return_log_probs: bool = False
     use_eod_for_early_termination: bool = True
+    # per-request wall-clock budget from submit(); None = no deadline.
+    # Enforced by the scheduler each round: an expired request fails its
+    # waiter with TimeoutError and RETIRES its slot — the pages go back
+    # to the pool instead of being held by a client that gave up.
+    deadline_s: Optional[float] = None
 
     tokens: List[int] = field(default_factory=list)
     log_probs: List[float] = field(default_factory=list)
     error: Optional[str] = None
+    timed_out: bool = False
     done: threading.Event = field(default_factory=threading.Event)
     t_submit: float = 0.0
     t_admit: float = 0.0
     t_first: float = 0.0  # first GENERATED token (TTFT = t_first - t_submit)
     t_done: float = 0.0
 
+    def expired(self, now: float) -> bool:
+        return (self.deadline_s is not None
+                and now - self.t_submit > self.deadline_s)
+
     def result(self, timeout: Optional[float] = None):
-        """Block until the request finishes; returns (tokens, log_probs)."""
+        """Block until the request finishes; returns (tokens, log_probs).
+        A request that blew its `deadline_s` raises TimeoutError (the
+        engine already reclaimed its slot/pages); other engine failures
+        raise RuntimeError."""
         if not self.done.wait(timeout):
             raise TimeoutError(f"request {self.rid} still running")
         if self.error is not None:
+            if self.timed_out:
+                raise TimeoutError(self.error)
             raise RuntimeError(self.error)
         return self.tokens, (self.log_probs if self.return_log_probs
                              else None)
@@ -461,6 +476,7 @@ class DecodeEngine:
         # counters (exported through the timers-gauge path)
         self._admitted = 0
         self._retired = 0
+        self._timed_out = 0  # deadline_s expiries (queued + running)
         self._steps = 0
         self._tokens_out = 0
         self._prefill_tokens = 0
@@ -483,11 +499,18 @@ class DecodeEngine:
                top_k: int = 1, top_p: float = 0.0,
                temperature: float = 1.0, seed: int = 0,
                return_log_probs: bool = False,
-               use_eod_for_early_termination: bool = True
+               use_eod_for_early_termination: bool = True,
+               deadline_s: Optional[float] = None,
                ) -> EngineRequest:
         """Queue one request. Raises ValueError when it cannot ever fit
         (prompt + generation past max_context) and QueueFull when the
-        queue is at capacity — callers map the latter to 503."""
+        queue is at capacity — callers map the latter to 503.
+
+        `deadline_s` is a wall-clock budget measured from submit: once
+        exceeded, the request's waiter fails with TimeoutError and —
+        when it was running — its slot retires and the pages return to
+        the free list, so an abandoned request can never pin pool
+        capacity or wedge the FIFO head forever."""
         total = len(prompt) + tokens_to_generate
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -512,6 +535,8 @@ class DecodeEngine:
                 f"page_budget or shrink the request")
         if self._broken is not None:
             raise RuntimeError(f"engine is stopped: {self._broken}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive, got {deadline_s}")
         req = EngineRequest(
             rid=-1, prompt=list(prompt),
             tokens_to_generate=tokens_to_generate,
@@ -519,6 +544,7 @@ class DecodeEngine:
             temperature=temperature, seed=seed,
             return_log_probs=return_log_probs,
             use_eod_for_early_termination=use_eod_for_early_termination,
+            deadline_s=deadline_s,
         )
         req.t_submit = time.perf_counter()
         with self._lock:
@@ -677,6 +703,39 @@ class DecodeEngine:
             return True
         return False
 
+    def _expire_deadlines(self) -> None:
+        """Fail every queued/running request past its wall-clock
+        deadline (TimeoutError at the waiter) and reclaim running slots'
+        pages — run once per scheduler round, so enforcement granularity
+        is one round (≤ one horizon scan / one mixed chunk)."""
+        now = time.perf_counter()
+        expired_q: List[EngineRequest] = []
+        with self._lock:
+            if any(r.expired(now) for r in self._queue):
+                keep = collections.deque()
+                for r in self._queue:
+                    if r.expired(now):
+                        expired_q.append(r)
+                    else:
+                        keep.append(r)
+                self._queue = keep
+        for r in expired_q:
+            r.error = (f"request {r.rid} exceeded deadline_s="
+                       f"{r.deadline_s} while queued")
+            r.timed_out = True
+            self._timed_out += 1
+            r.done.set()
+        for i, s in enumerate(self._slots):
+            r = s.req
+            if r is not None and r.expired(now):
+                r.error = (f"request {r.rid} exceeded deadline_s="
+                           f"{r.deadline_s} after {len(r.tokens) - len(r.prompt)}"
+                           f"/{r.tokens_to_generate} generated tokens; "
+                           f"slot retired, pages reclaimed")
+                r.timed_out = True
+                self._timed_out += 1
+                self._retire(i)
+
     def step(self) -> bool:
         """One scheduler iteration. Chunked admission (the default):
         while any slot is mid-prefill, run one MIXED round — a budget-
@@ -688,6 +747,7 @@ class DecodeEngine:
         window behind `serve_decode_p95_ms`. Returns False when there
         was nothing to do (idle)."""
         t0 = time.perf_counter()
+        self._expire_deadlines()
         admit_prefilled = self._admit()
         if self.prefill_chunk_tokens and any(
                 s.prefilling for s in self._slots):
@@ -1008,6 +1068,19 @@ class DecodeEngine:
             return 0.0
         return xs[min(int(p * len(xs)), len(xs) - 1)]
 
+    def health(self) -> dict:
+        """Liveness snapshot for GET /health (inference/server.py): is
+        the serve loop running, did it die poisoned (`_broken` carries
+        the fatal step error), and how much work is pending. Cheap by
+        design — a load balancer polls this."""
+        alive = self._thread is not None and self._thread.is_alive()
+        return {
+            "alive": alive,
+            "broken": self._broken,
+            "queue_depth": len(self._queue),
+            "slots_busy": sum(1 for s in self._slots if s.req is not None),
+        }
+
     def counters(self) -> dict:
         """Live serving counters; exported via `export_gauges` through
         the existing timers-gauge path (training/timers.py) and served
@@ -1035,6 +1108,7 @@ class DecodeEngine:
             "serve_pages_free": len(self._free_pages),
             "serve_admitted": self._admitted,
             "serve_retired": self._retired,
+            "serve_timed_out": self._timed_out,
             "serve_steps": self._steps,
             "serve_tok_s": round(self._tokens_out / dt, 2),
             "serve_prefill_tokens": self._prefill_tokens,

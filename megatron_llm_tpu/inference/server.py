@@ -23,6 +23,12 @@ GET /metrics (engine-attached servers) returns the live
 `DecodeEngine.counters()` dict — slot occupancy, queue depth, page
 accounting, tok/s, and the ISSUE-4 latency gauges (serve_ttft_p50_ms /
 serve_ttft_p95_ms / serve_decode_p95_ms) — as JSON.
+
+GET /health (ISSUE 5) is the load-balancer probe: 200 while the serving
+path can take traffic, 503 once the engine's serve loop died poisoned
+(`DecodeEngine._broken`) or its thread stopped, with the engine's
+liveness snapshot (alive / broken / queue_depth / slots_busy) as the
+body. Engineless servers always answer 200.
 """
 
 from __future__ import annotations
@@ -47,11 +53,16 @@ QUEUE_FULL_MSG = "generation queue is full"
 class MegatronGenerate:
     """Request validation + dispatch (ref: MegatronGenerate :17-233)."""
 
-    def __init__(self, model, params, tokenizer, engine=None):
+    def __init__(self, model, params, tokenizer, engine=None,
+                 request_deadline_s=None):
         self.model = model
         self.params = params
         self.tokenizer = tokenizer
         self.engine = engine
+        # server-wide wall-clock budget applied to every engine request
+        # (DecodeEngine deadline semantics: expiry fails the waiter and
+        # reclaims the slot's pages); None = no deadline
+        self.request_deadline_s = request_deadline_s
 
     def put(self, raw: dict):
         """Returns (payload, http_status); validation messages mirror the
@@ -235,6 +246,7 @@ class MegatronGenerate:
                         top_k=top_k, top_p=top_p, temperature=temperature,
                         seed=seed, return_log_probs=logprobs,
                         use_eod_for_early_termination=True,
+                        deadline_s=self.request_deadline_s,
                     ))
                 except QueueFull:
                     # admitted prefixes of THIS PUT still complete; the
@@ -242,7 +254,14 @@ class MegatronGenerate:
                     return {"message": QUEUE_FULL_MSG}, 503
             rows, lps = [], []
             for r in reqs:
-                toks, lp = r.result(timeout=600.0)
+                try:
+                    toks, lp = r.result(timeout=600.0)
+                except TimeoutError as e:
+                    # per-request deadline expiry (engine deadline_s) is
+                    # overload shed, not an engine fault: 504 +
+                    # Retry-After so clients and monitoring can tell it
+                    # from a real 5xx crash
+                    return {"message": repr(e)}, 504
                 rows.append(toks)
                 lps.append(lp)
             max_len = max(len(t) for t in rows)
@@ -278,6 +297,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
             return
+        if self.path.rstrip("/") == "/health":
+            # liveness/readiness probe (ISSUE 5): 200 while the serving
+            # path can take traffic, 503 once the engine's serve loop
+            # died poisoned (DecodeEngine._broken) or its thread is gone
+            # — a load balancer drains the replica instead of feeding
+            # requests into hung waiters. Engineless (whole-batch-only)
+            # servers are always 200: every PUT runs inline.
+            eng = self.generator.engine
+            if eng is None:
+                self._respond({"status": "ok", "engine": None}, 200)
+                return
+            h = eng.health()
+            healthy = h["broken"] is None and h["alive"]
+            self._respond(
+                {"status": "ok" if healthy else "unhealthy", "engine": h},
+                200 if healthy else 503)
+            return
         if self.path.rstrip("/") == "/metrics":
             # live engine counters (DecodeEngine.counters — occupancy,
             # queue depth, pages, tok/s, and the latency gauges
@@ -312,9 +348,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
-        if status == 503:
-            # overload (busy device / full queue): tell clients when to
-            # come back instead of letting them hammer the socket
+        if status in (503, 504):
+            # overload (busy device / full queue / deadline shed): tell
+            # clients when to come back instead of letting them hammer
+            # the socket
             self.send_header("Retry-After", "1")
         self.end_headers()
         self.wfile.write(data)
@@ -329,10 +366,12 @@ class MegatronServer:
     through the continuous-batching queue; its serve loop is started by
     `run` and gracefully drained by `stop`."""
 
-    def __init__(self, model, params, tokenizer, engine=None):
+    def __init__(self, model, params, tokenizer, engine=None,
+                 request_deadline_s=None):
         self.engine = engine
-        self.generator = MegatronGenerate(model, params, tokenizer,
-                                          engine=engine)
+        self.generator = MegatronGenerate(
+            model, params, tokenizer, engine=engine,
+            request_deadline_s=request_deadline_s)
         self._httpd = None
 
     def run(self, host: str = "0.0.0.0", port: int = 5000,

@@ -126,11 +126,17 @@ def optimizer_step(
     grad_norm = global_grad_norm(grads)
     finite = jnp.isfinite(grad_norm)
     if found_inf is not None:
+        # external skip gate (the loss watchdog's spike/NaN flag): skips
+        # the UPDATE only. It must not feed the scaler below — a
+        # finite-gradient loss spike is not an fp16 overflow, and
+        # backing the scale off for it would ratchet toward underflow.
         finite = finite & ~found_inf
 
     new_scaler_state = state.scaler
     if scaler is not None:
-        new_scaler_state = scaler.update(state.scaler, ~finite)
+        # the scaler reacts to GENUINE overflow (non-finite grads) only
+        new_scaler_state = scaler.update(state.scaler,
+                                         ~jnp.isfinite(grad_norm))
 
     # clip (ref: clip_grads.py:83-107)
     if tcfg.clip_grad > 0.0:

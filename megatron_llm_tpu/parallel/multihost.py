@@ -106,6 +106,20 @@ def all_hosts_any(flag: bool) -> bool:
     return bool(np.max(flags) > 0)
 
 
+def host_barrier(tag: str = "barrier") -> None:
+    """Every process blocks until ALL processes have reached this call —
+    the pod-wide sync around a preemption fast-save (ISSUE 5): the hosts
+    agree to save (all_hosts_any on the SIGTERM latch), each contributes
+    its shards to the orbax save, then barrier AGAIN so no host exits —
+    tearing down its TPU runtime — while a peer is still committing.
+    Single-process: no-op. `tag` only aids debugging hung barriers."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
 class AutoResume:
     """Sentinel-file termination hook (TPU analogue of ADLR autoresume,
     ref: utils.py:117-135 + training.py:712-725): when `path` exists (a

@@ -1032,7 +1032,8 @@ def make_pipelined_train_step(model, tcfg, pcfg, ctx: ParallelContext):
     loss_fn = make_pipelined_loss_fn(model, pcfg, ctx)
     scaler = get_grad_scaler(tcfg)
 
-    def train_step(params, opt_state, batch, lr, wd, rng=None):
+    def train_step(params, opt_state, batch, lr, wd, rng=None,
+                   spike_threshold=None):
         loss_scale = (
             scaler.scale(opt_state.scaler) if scaler is not None else None
         )
@@ -1050,9 +1051,15 @@ def make_pipelined_train_step(model, tcfg, pcfg, ctx: ParallelContext):
             # unscale; the overflow check rides optimizer_step's grad norm
             inv = 1.0 / loss_scale
             grads = jax.tree.map(lambda g: g * inv, grads)
+        found_inf = None
+        if spike_threshold is not None:
+            # the loss watchdog's in-step skip gate — same contract as
+            # the non-pipelined step (training/train_step.py): skips
+            # the update; never drives the fp16 scale
+            found_inf = ~jnp.isfinite(loss) | (loss > spike_threshold)
         params, opt_state, stats = optimizer_step(
             params, grads, opt_state, tcfg, lr, weight_decay=wd,
-            scaler=scaler,
+            found_inf=found_inf, scaler=scaler,
         )
         stats["loss"] = loss
         return params, opt_state, stats

@@ -29,7 +29,8 @@ from megatron_llm_tpu.optimizer.optimizer import OptimizerState, optimizer_step
 
 
 def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
-    """Returns train_step(params, opt_state, batch, lr, wd, rng).
+    """Returns train_step(params, opt_state, batch, lr, wd, rng,
+    spike_threshold).
 
     `batch` dict of (num_microbatches, batch, seq) arrays with keys
     tokens / labels / loss_mask (loss_mask optional). When
@@ -40,6 +41,14 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
     grads, skip the step on overflow, and update the dynamic scale — the
     whole Float16OptimizerWithFloat16Params protocol
     (ref: optimizer/optimizer.py:270-466) inside the one jitted step.
+
+    `spike_threshold` (optional TRACED fp32 scalar, the loss watchdog's
+    current median + k*sigma, training/watchdog.py): when given, a step
+    whose mean loss is non-finite or above it is SKIPPED in-step —
+    params/optimizer untouched, stats["skipped"] set — by riding the
+    same found_inf machinery the fp16 scaler uses, so bf16 runs get the
+    identical no-host-round-trip skip path. Pass +inf for "no spike
+    gating, still skip NaN/inf losses".
     """
     from megatron_llm_tpu.optimizer.optimizer import get_grad_scaler
 
@@ -62,7 +71,8 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
             return loss * loss_scale, loss
         return loss, loss
 
-    def train_step(params, opt_state: OptimizerState, batch, lr, wd, rng=None):
+    def train_step(params, opt_state: OptimizerState, batch, lr, wd,
+                   rng=None, spike_threshold=None):
         loss_scale = (
             scaler.scale(opt_state.scaler) if scaler is not None else None
         )
@@ -99,9 +109,18 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
             inv = 1.0 / loss_scale
             grads = jax.tree.map(lambda g: g * inv, grads)
 
+        found_inf = None
+        if spike_threshold is not None:
+            # loss-level gate: NaN/inf losses AND watchdog spikes skip
+            # the update exactly like an fp16 overflow skips it (the
+            # grad-norm finiteness check inside optimizer_step still
+            # applies on top). The fp16 loss SCALE only reacts to
+            # genuine overflow, never to this gate — optimizer_step
+            # keeps the two signals separate.
+            found_inf = ~jnp.isfinite(loss) | (loss > spike_threshold)
         new_params, new_state, stats = optimizer_step(
             params, grads, opt_state, tcfg, lr, weight_decay=wd,
-            scaler=scaler,
+            found_inf=found_inf, scaler=scaler,
         )
         stats["loss"] = loss
         return new_params, new_state, stats

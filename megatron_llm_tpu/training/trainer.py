@@ -34,10 +34,14 @@ from megatron_llm_tpu.parallel.sharding import (
     optimizer_state_specs,
     param_specs,
 )
-from megatron_llm_tpu.training.checkpointing import load_checkpoint, save_checkpoint
+from megatron_llm_tpu.training.checkpointing import (
+    CheckpointManager,
+    load_checkpoint,
+)
 from megatron_llm_tpu.training.microbatches import build_num_microbatches_calculator
 from megatron_llm_tpu.training.timers import Timers
 from megatron_llm_tpu.training.train_step import make_train_step
+from megatron_llm_tpu.training.watchdog import LossWatchdog
 from megatron_llm_tpu.utils.masks import get_ltor_masks_and_position_ids
 
 
@@ -185,6 +189,19 @@ class Trainer:
         self.signal_handler = (
             SignalHandler() if tcfg.exit_signal_handler else None
         )
+        # fault tolerance (ISSUE 5): the async checkpoint writer is
+        # created lazily on first save (tcfg.save may be None), the loss
+        # watchdog always exists — with ksigma/patience at 0 it only
+        # blocks NaN/inf losses from reaching the weights (the in-step
+        # skip gate) and counts them.
+        self._ckpt_manager: Optional[CheckpointManager] = None
+        self._loaded_ckpt_path: Optional[str] = None
+        self.watchdog = LossWatchdog(
+            k_sigma=tcfg.loss_watchdog_ksigma,
+            window=max(tcfg.loss_watchdog_window, 4),
+            patience=tcfg.spike_rollback_patience,
+        )
+        self._dropout_base_rng: Optional[jax.Array] = None
         self._autoresume = None
         if tcfg.autoresume_file:
             from megatron_llm_tpu.parallel.multihost import AutoResume
@@ -288,6 +305,9 @@ class Trainer:
                 )
                 if meta.get("scheduler") and not self.tcfg.finetune:
                     self.scheduler.load_state_dict(meta["scheduler"])
+                # retention GC must never delete the checkpoint a resume
+                # read from (checkpointing.py CheckpointManager.protect)
+                self._loaded_ckpt_path = meta.get("loaded_path")
                 print(f"loaded checkpoint from {self.tcfg.load} at iteration "
                       f"{state.iteration}", flush=True)
         return state
@@ -381,9 +401,13 @@ class Trainer:
             batch = globalize_batch(batch, self.ctx)
         step_fn = self._get_step_fn(num_micro)
         first_step = state.iteration == 0 and not self._run_facts_logged
+        # the loss watchdog's in-step skip gate: +inf until the window
+        # has history (or with spike detection off) — NaN/inf losses
+        # still skip. Always passed, so there is ONE trace either way.
+        spike_thr = jnp.float32(self.watchdog.threshold())
         params, opt_state, stats = step_fn(
             state.params, state.opt_state, batch,
-            jnp.float32(lr), jnp.float32(wd), dropout_rng,
+            jnp.float32(lr), jnp.float32(wd), dropout_rng, spike_thr,
         )
         state.params = params
         state.opt_state = opt_state
@@ -394,7 +418,7 @@ class Trainer:
             self._log_run_facts(
                 step_fn,
                 (params, opt_state, batch, jnp.float32(lr),
-                 jnp.float32(wd), dropout_rng),
+                 jnp.float32(wd), dropout_rng, spike_thr),
             )
         state.iteration += 1
         mbs_dp = jax.tree.leaves(batch)[0].shape[1]
@@ -519,6 +543,11 @@ class Trainer:
         if "params_norm" in stats:
             line += f"params norm: {float(stats['params_norm']):.3f} | "
         line += f"skipped iterations: {int(stats['skipped'])}"
+        # watchdog counters ride the gauge channel, re-armed only when
+        # they actually move (a gauge re-set reprints on the next log)
+        for name, val in self.watchdog.counters().items():
+            if self.timers.gauges().get(name) != val:
+                self.timers.gauge(name, val)
         # throughput + achieved model-FLOP/s (the reference logs
         # elapsed-per-iteration only; TFLOP/s makes MFU one division away)
         if self._n_params:
@@ -560,6 +589,14 @@ class Trainer:
             # ref: --log_timers_to_tensorboard writes iteration-time
             # (training.py:598-600)
             w.add_scalar("iteration-time", elapsed, it)
+        # fault-tolerance counters (ISSUE 5): spikes skipped, rollbacks
+        # taken, and the async-save stall — the WandB-visible proof the
+        # watchdog/async-checkpoint path is doing its job
+        w.add_scalar("loss-watchdog-skipped", self.watchdog.skipped, it)
+        w.add_scalar("loss-watchdog-rollbacks", self.watchdog.rollbacks, it)
+        if self._ckpt_manager is not None and self._ckpt_manager.saves:
+            w.add_scalar("ckpt-blocked-ms",
+                         self._ckpt_manager.last_blocked_ms, it)
         if self.tcfg.log_memory_to_tensorboard:
             # ref: --log_memory_to_tensorboard (training.py:601-607);
             # here the device allocator's live-bytes gauge
@@ -573,18 +610,93 @@ class Trainer:
             # ref: flush_all batching (training.py:706-708)
             w.flush()
 
-    def _save(self, state: TrainState):
+    def _get_ckpt_manager(self) -> CheckpointManager:
+        if self._ckpt_manager is None:
+            self._ckpt_manager = CheckpointManager(
+                self.tcfg.save, keep_latest_n=self.tcfg.keep_latest_n,
+                async_save=self.tcfg.async_save,
+            )
+            self._ckpt_manager.protect(self._loaded_ckpt_path)
+        return self._ckpt_manager
+
+    def _save(self, state: TrainState, blocking: bool = False):
+        """Interval save: async by default — the loop stalls only for
+        the previous save's tail + the device→host copy, surfaced as the
+        `ckpt_blocked_ms` gauge. `blocking=True` (exit paths: emergency
+        save, final save, rollback prep) additionally waits for the
+        commit so the process may die right after."""
         if not self.tcfg.save:
             return
+        mgr = self._get_ckpt_manager()
         self.timers("save-checkpoint").start()
-        save_checkpoint(
-            self.tcfg.save, state.iteration, state.params,
+        mgr.save(
+            state.iteration, state.params,
             None if self.tcfg.no_save_optim else state.opt_state,
-            self.cfg, self.scheduler.state_dict(), state.consumed_train_samples,
+            self.cfg, self.scheduler.state_dict(),
+            state.consumed_train_samples,
+            rng_key=self._dropout_base_rng,
         )
         self.timers("save-checkpoint").stop()
+        self.timers.gauge("ckpt_blocked_ms", round(mgr.last_blocked_ms, 2))
+        if blocking:
+            mgr.wait_until_finished()
         print(f"saved checkpoint at iteration {state.iteration} to "
-              f"{self.tcfg.save}", flush=True)
+              f"{self.tcfg.save}"
+              f"{' (committed)' if blocking else ' (async)'}", flush=True)
+
+    def _rollback(self, state: TrainState) -> bool:
+        """Loss-watchdog escalation: reload the last COMPLETE checkpoint
+        into the live state and KEEP the data iterator where it is — the
+        batches between the checkpoint and now (the poison window) are
+        consumed-but-never-trained-on, which is exactly the manual
+        restart-and-skip loop of the big-run reports, automated. Returns
+        False (and keeps skip-only behavior) when there is nothing to
+        roll back to."""
+        if not self.tcfg.save:
+            print("WARNING: loss watchdog wants a rollback but no --save "
+                  "dir is configured; continuing in skip-only mode",
+                  flush=True)
+            return False
+        # the in-flight async save must finalize first: it is newer than
+        # anything on disk and about to become the rollback target
+        self._get_ckpt_manager().wait_until_finished()
+        loaded = load_checkpoint(
+            self.tcfg.save, state.params,
+            # --no_save_optim checkpoints have no optim dir: don't let
+            # the torn-save scan misread every healthy checkpoint as
+            # corrupt trying to restore one
+            None if self.tcfg.no_save_optim else state.opt_state,
+            self.cfg,
+            no_load_optim=self.tcfg.no_save_optim
+            or self.tcfg.no_load_optim,
+        )
+        if loaded is None:
+            print("WARNING: loss watchdog wants a rollback but no "
+                  "complete checkpoint exists yet; continuing in "
+                  "skip-only mode", flush=True)
+            return False
+        params, opt_state, meta, iteration = loaded
+        poison = state.iteration - iteration
+        state.params = params
+        if opt_state is not None:
+            state.opt_state = opt_state
+        state.iteration = iteration
+        # consumed_train_samples is NOT rewound: it is the data
+        # position (loaders — and a later crash-resume — restart from
+        # it), and the live iterator stays where it is. Rewinding the
+        # counter while the iterator kept going would replay the poison
+        # window on the next restart — the opposite of fast-forward.
+        # The poison batches stay consumed-but-untrained; the scheduler
+        # replays its own state from the checkpoint.
+        if meta.get("scheduler"):
+            self.scheduler.load_state_dict(meta["scheduler"])
+        self._get_ckpt_manager().protect(meta.get("loaded_path"))
+        self.watchdog.note_rollback()
+        print(f"LOSS WATCHDOG ROLLBACK: reloaded iteration {iteration} "
+              f"from {self.tcfg.save}; data iterator fast-forwarded past "
+              f"the {poison}-iteration poison window "
+              f"(rollback #{self.watchdog.rollbacks})", flush=True)
+        return True
 
     def train(self, state: TrainState) -> TrainState:
         """The loop (ref: _train training.py:639-752)."""
@@ -595,6 +707,10 @@ class Trainer:
         dropout_rng = None
         if self.cfg.hidden_dropout > 0 or self.cfg.attention_dropout > 0:
             dropout_rng = jax.random.key(tcfg.seed + 1)
+            # saved in checkpoint meta: resume folds the SAME base key
+            # with the restored iteration, so the dropout stream — and
+            # therefore the loss trajectory — is bitwise on resume
+            self._dropout_base_rng = dropout_rng
 
         def keep_going():
             if self._samples_mode:
@@ -638,6 +754,19 @@ class Trainer:
                 jax.profiler.stop_trace()
                 self._trace_active = False
 
+            # loss watchdog: a bad step (NaN/inf or >k-sigma spike) was
+            # already SKIPPED on device by the spike-threshold gate; the
+            # host side counts the streak and escalates to a rollback
+            # after `spike_rollback_patience` consecutive bad steps.
+            if self.watchdog.observe(loss_val):
+                print(f"loss watchdog: bad step at iteration "
+                      f"{state.iteration} (loss {loss_val:.6E}, "
+                      f"threshold {self.watchdog.threshold():.6E}, "
+                      f"streak {self.watchdog.consecutive_bad})",
+                      flush=True)
+                if self.watchdog.should_rollback():
+                    self._rollback(state)
+
             if state.iteration % tcfg.log_interval == 0:
                 self._training_log(state, stats, elapsed)
             self._tb_log(state, stats, elapsed)
@@ -670,25 +799,38 @@ class Trainer:
             # dist_signal_handler.py:53-57, training.py:727-739) so a pod
             # where one host catches SIGTERM or crosses the limit first
             # exits together.
-            from megatron_llm_tpu.parallel.multihost import all_hosts_any
+            from megatron_llm_tpu.parallel.multihost import (
+                all_hosts_any,
+                host_barrier,
+            )
 
             if self.signal_handler is not None:
                 if all_hosts_any(self.signal_handler.signals_received()):
-                    print("exiting on termination signal", flush=True)
-                    self._save(state)
+                    # preemption fast-save: the all_hosts_any above is
+                    # the BEFORE consensus (every host enters the save
+                    # branch together); the barrier after the committed
+                    # save keeps any host from tearing down its runtime
+                    # while a peer is still writing shards — the pod
+                    # exits as one.
+                    print("exiting on termination signal — emergency "
+                          "save", flush=True)
+                    self._save(state, blocking=True)
+                    host_barrier("emergency-save-done")
                     break
             if tcfg.exit_duration_in_mins is not None:
                 over = (time.time() - start_time) / 60.0 \
                     > tcfg.exit_duration_in_mins
                 if all_hosts_any(over):
                     print("exiting on duration limit", flush=True)
-                    self._save(state)
+                    self._save(state, blocking=True)
+                    host_barrier("duration-save-done")
                     break
             if self._autoresume is not None and \
                     self._autoresume.termination_requested(state.iteration):
                 print("exiting on autoresume termination request",
                       flush=True)
-                self._save(state)
+                self._save(state, blocking=True)
+                host_barrier("autoresume-save-done")
                 break
             if tcfg.exit_interval and state.iteration % tcfg.exit_interval == 0:
                 print(f"exiting at iteration {state.iteration}", flush=True)
@@ -697,6 +839,10 @@ class Trainer:
             # early exit inside the profile window: flush the trace
             jax.profiler.stop_trace()
             self._trace_active = False
+        # the one place the loop pays a full commit wait: exit. An
+        # in-flight interval save must land before the process may die.
+        if self._ckpt_manager is not None:
+            self._ckpt_manager.wait_until_finished()
         return state
 
 
@@ -775,5 +921,5 @@ def pretrain(
 
     state = trainer.train(state)
     if tcfg.save:
-        trainer._save(state)
+        trainer._save(state, blocking=True)
     return state

@@ -1,0 +1,130 @@
+"""Child trainer for tests/test_fault_tolerance.py's kill-and-resume test.
+
+Usage: python _ft_child.py <workdir> [--train_iters N] [--step_delay S]
+
+Runs a tiny deterministic GPT training loop (single CPU device, highest
+matmul precision) with the full fault-tolerance stack live: async
+CheckpointManager interval saves, SIGTERM latch -> emergency save ->
+clean exit, auto-resume from <workdir>/ckpt.
+
+Determinism contract (what the parent asserts bitwise): the batch at any
+point is a pure function of `consumed_train_samples` (each sample's
+tokens come from np.RandomState(SEED_BASE + global sample index)), the
+dropout stream is fold_in(key(seed+1), iteration), and params/optimizer
+come off the checkpoint — so a resumed run MUST reproduce the
+uninterrupted run's per-step losses to the bit, or something in
+(params, opt, rng, data position) did not survive the round trip.
+
+Every step appends `STEP <iteration> <loss.hex()>` to <workdir>/losses.txt
+(fsync'd so the parent can poll it and so a SIGTERM right after a step
+still leaves the line on disk).
+"""
+
+from __future__ import annotations
+
+import sys
+
+TRAIN_ITERS = 12
+SAVE_INTERVAL = 4
+GBS = 2  # micro_batch_size 2 x 1 microbatch
+SEED_BASE = 1000
+
+
+def make_child_cfg():
+    """Shared with the parent test (it loads the final checkpoints with
+    the same architecture)."""
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.config import tiny_config
+
+    return tiny_config(
+        seq_length=16, max_position_embeddings=16,
+        hidden_dropout=0.1,  # exercises the rng leg of bitwise resume
+        compute_dtype=jnp.float32, params_dtype=jnp.float32,
+    )
+
+
+def make_child_tcfg(ckpt_dir: str, train_iters: int = TRAIN_ITERS):
+    from megatron_llm_tpu.config import TrainConfig
+
+    return TrainConfig(
+        micro_batch_size=2, global_batch_size=GBS, lr=1e-3,
+        train_iters=train_iters, log_interval=1, eval_interval=0,
+        save=ckpt_dir, load=ckpt_dir, save_interval=SAVE_INTERVAL,
+        exit_signal_handler=True, async_save=True, keep_latest_n=3,
+        seed=1234,
+    )
+
+
+def batch_for(sample0: int, seqp1: int, vocab: int):
+    """The (1, GBS, seq+1) global batch whose first row is global sample
+    `sample0` — a pure function of the data position."""
+    import numpy as np
+
+    out = np.zeros((1, GBS, seqp1), np.int32)
+    for r in range(GBS):
+        rng = np.random.RandomState(SEED_BASE + sample0 + r)
+        out[0, r] = rng.randint(0, vocab, size=seqp1)
+    return out
+
+
+def main(workdir: str, train_iters: int, step_delay: float) -> None:
+    import os
+    import time
+
+    from megatron_llm_tpu.config import ParallelConfig
+    from megatron_llm_tpu.models import LlamaModel
+    from megatron_llm_tpu.training.trainer import Trainer
+
+    cfg = make_child_cfg()
+    model = LlamaModel(cfg)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    tcfg = make_child_tcfg(ckpt_dir, train_iters)
+    trainer = Trainer(model, tcfg, ParallelConfig(num_microbatches=1))
+
+    loss_file = os.path.join(workdir, "losses.txt")
+    orig_log = trainer._training_log
+
+    def logging_log(state, stats, elapsed):
+        with open(loss_file, "a") as f:
+            f.write(f"STEP {state.iteration} "
+                    f"{float(stats['loss']).hex()}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        orig_log(state, stats, elapsed)
+
+    trainer._training_log = logging_log
+
+    state = trainer.setup()  # auto-resumes from ckpt_dir when present
+
+    def batches():
+        while True:
+            if step_delay:
+                time.sleep(step_delay)
+            # data position IS consumed_train_samples — a resume
+            # continues exactly where the checkpoint's counter says
+            yield batch_for(state.consumed_train_samples,
+                            cfg.seq_length + 1, cfg.padded_vocab_size)
+
+    trainer.train_data_iterator = batches()
+    state = trainer.train(state)
+    trainer._save(state, blocking=True)
+    print(f"DONE iter={state.iteration} "
+          f"consumed={state.consumed_train_samples}", flush=True)
+
+
+if __name__ == "__main__":
+    import argparse
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    p = argparse.ArgumentParser()
+    p.add_argument("workdir")
+    p.add_argument("--train_iters", type=int, default=TRAIN_ITERS)
+    p.add_argument("--step_delay", type=float, default=0.0)
+    a = p.parse_args()
+    main(a.workdir, a.train_iters, a.step_delay)
+    sys.exit(0)

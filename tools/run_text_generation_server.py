@@ -56,6 +56,11 @@ def main():
                         "executables for the configured buckets before "
                         "serving, so the first request never eats the "
                         "compile stall")
+    p.add_argument("--request_deadline_s", type=float, default=None,
+                   help="per-request wall-clock budget: an engine "
+                        "request past it fails with a timeout and its "
+                        "slot's KV pages return to the pool (ISSUE 5 "
+                        "serving robustness; default: no deadline)")
     args = p.parse_args()
 
     import jax
@@ -129,9 +134,10 @@ def main():
              + (f"chunked prefill {engine.prefill_chunk_tokens} tok/round"
                 if engine.prefill_chunk_tokens else
                 "whole-prompt prefill")
-             + ", counters at /metrics)"
+             + ", counters at /metrics, health at /health)"
              if engine else " (whole-batch, no engine)"), flush=True)
-    MegatronServer(model, params, tokenizer, engine=engine).run(
+    MegatronServer(model, params, tokenizer, engine=engine,
+                   request_deadline_s=args.request_deadline_s).run(
         args.host, args.port)
 
 
