@@ -476,10 +476,123 @@ def serving_interference_stats(model, params, *, slots=4, page_size=64,
     }
 
 
+def serving_prefix_stats(model, params, *, slots=4, page_size=64,
+                         max_context=768, chunk=128, vocab_size=32000,
+                         n_requests=10, shared_frac=0.8,
+                         sys_prompt=384, uniq_suffix=32, gen=48):
+    """Prefix-sharing benefit at a realistic shared-system-prompt mix
+    (ISSUE 6). Methodology (stated in the emitted row): `shared_frac`
+    of the requests open with the SAME system prompt plus a short
+    unique suffix — the production multi-tenant pattern — and the rest
+    are fully unique at the same total length; the identical greedy
+    burst runs through a prefix-cache engine and an unshared engine
+    (both chunked, both compile-warmed off the clock, cache cold at
+    t0 — the first `slots`-wide admission wave looks up before any
+    page registers, so those shared requests pay their full prefill
+    honestly inside the run; later shared admissions hit). Headlines:
+    `shared_vs_unshared_ttft_p95` (> 1 means sharing cut p95 TTFT),
+    `shared_vs_unshared_tok_s`, the per-request prefill-token
+    reduction (cache-hit tokens never run a forward), and the PEAK
+    pages-in-use delta (shared prefix pages are stored once)."""
+    import numpy as np
+
+    from megatron_llm_tpu.inference.engine import DecodeEngine
+
+    rs = np.random.RandomState(0)
+    sysp = list(rs.randint(2, vocab_size, sys_prompt))
+    uniq_every = max(int(round(1.0 / max(1.0 - shared_frac, 1e-9))), 1)
+    work = []
+    n_shared = 0
+    for i in range(n_requests):
+        if (i % uniq_every) != uniq_every - 1:
+            work.append(sysp + list(rs.randint(2, vocab_size,
+                                               uniq_suffix)))
+            n_shared += 1
+        else:
+            work.append(list(rs.randint(2, vocab_size,
+                                        sys_prompt + uniq_suffix)))
+    pct = DecodeEngine._pct
+
+    out = {}
+    for mode, share in (("shared", True), ("unshared", False)):
+        eng = DecodeEngine(
+            model, params, slots=slots, page_size=page_size,
+            max_context=max_context, max_queue=n_requests,
+            termination_id=None, vocab_size=vocab_size,
+            prefill_chunk_tokens=chunk, prefix_cache=share)
+        # compile-warm off the clock (both prompt shapes + the
+        # scan/mixed buckets); the prefix CACHE stays cold — clear it
+        # so the measured run's first shared request pays the one miss
+        eng.submit(work[0][:sys_prompt // 2], 2, top_k=1)
+        eng.drain()
+        eng.warmup()
+        eng.reset_prefix_cache()
+        eng._ttft_ms.clear()
+        eng._decode_ms.clear()
+        pf0 = eng._prefill_tokens
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, gen, top_k=1) for p in work]
+        peak_pages = 0
+        while eng.step():
+            c = eng.counters()
+            peak_pages = max(peak_pages, c["serve_pages_in_use"])
+        makespan = max(r.t_done for r in reqs) - t0
+        ttfts = [(r.t_first - r.t_submit) * 1e3 for r in reqs]
+        row = {
+            "ttft_p50_ms": round(pct(ttfts, 0.50), 2),
+            "ttft_p95_ms": round(pct(ttfts, 0.95), 2),
+            "tok_s": round(n_requests * gen / makespan, 1),
+            "prefill_tokens_per_request": round(
+                (eng._prefill_tokens - pf0) / n_requests, 1),
+            "peak_pages_in_use": peak_pages,
+        }
+        if share:
+            row.update({k: v for k, v in eng.counters().items()
+                        if "prefix" in k})
+        out[mode] = row
+    return {
+        "slots": slots,
+        "n_requests": n_requests,
+        "shared_requests": n_shared,
+        "sys_prompt_tokens": sys_prompt,
+        "uniq_suffix_tokens": uniq_suffix,
+        "shared": out["shared"],
+        "unshared": out["unshared"],
+        "shared_vs_unshared_ttft_p95": round(
+            out["unshared"]["ttft_p95_ms"]
+            / max(out["shared"]["ttft_p95_ms"], 1e-9), 2),
+        "shared_vs_unshared_tok_s": round(
+            out["shared"]["tok_s"]
+            / max(out["unshared"]["tok_s"], 1e-9), 2),
+        "prefill_token_reduction": round(
+            1.0 - out["shared"]["prefill_tokens_per_request"]
+            / max(out["unshared"]["prefill_tokens_per_request"], 1e-9),
+            3),
+        "peak_pages_in_use_delta": (
+            out["unshared"]["peak_pages_in_use"]
+            - out["shared"]["peak_pages_in_use"]),
+        "methodology": (
+            f"identical greedy burst both engines: {n_shared}/"
+            f"{n_requests} requests = {sys_prompt}-token shared system "
+            f"prompt + {uniq_suffix} unique tokens, the rest fully "
+            f"unique at the same length; both engines chunked "
+            f"({chunk} tok/round) and compile-warmed off the clock, "
+            f"prefix cache cold at t0 (the first {slots}-wide "
+            "admission wave looks up before any page registers and "
+            "pays full prefill in-run; later shared admissions hit); "
+            "TTFT = submit -> first generated "
+            "token; tok/s = requested gen tokens / makespan; prefill "
+            "tokens/request counts forward-pass prompt tokens "
+            "(cache hits skip theirs); peak pages sampled per round"
+        ),
+    }
+
+
 def run_serving(n_requests=16, slots=8):
     """bench-model serving row (bf16 decode weights, decode kernel on):
-    the ISSUE-3 continuous-vs-static comparison plus the ISSUE-4
-    long-prompt-admission interference audit."""
+    the ISSUE-3 continuous-vs-static comparison, the ISSUE-4
+    long-prompt-admission interference audit, and the ISSUE-6
+    shared-system-prompt prefix-sharing comparison."""
     import dataclasses
 
     cfg = dataclasses.replace(make_cfg(1024), params_dtype=jnp.bfloat16)
@@ -488,6 +601,7 @@ def run_serving(n_requests=16, slots=8):
     work, arrivals = make_serving_workload(n_requests)
     stats = serving_stats(model, params, work, arrivals, slots=slots)
     stats["interference"] = serving_interference_stats(model, params)
+    stats["prefix"] = serving_prefix_stats(model, params)
     return stats
 
 
@@ -850,7 +964,14 @@ def main():
             f"vs whole-prompt (decode p95 "
             f"{serving['interference']['chunked']['decode_p95_ms']} vs "
             f"{serving['interference']['wholeprompt']['decode_p95_ms']}"
-            f" ms); async ckpt blocks the loop "
+            f" ms); prefix sharing at the 80%-shared-system-prompt mix: "
+            f"p95 TTFT "
+            f"{serving['prefix']['shared_vs_unshared_ttft_p95']}x, "
+            f"tok/s {serving['prefix']['shared_vs_unshared_tok_s']}x, "
+            f"prefill tokens/request "
+            f"-{serving['prefix']['prefill_token_reduction']:.0%}, "
+            f"peak pages -{serving['prefix']['peak_pages_in_use_delta']}"
+            f"; async ckpt blocks the loop "
             f"{ckpt['async_blocked_ms']:.0f}ms = "
             f"{ckpt['async_vs_sync_stall']:.0%} of the "
             f"{ckpt['sync_save_ms']:.0f}ms sync save "

@@ -552,6 +552,25 @@ def args_to_configs(args, padded_vocab_size: int):
     import jax
 
     cp = getattr(args, "context_parallel_size", 1) or 1
+    if cp > 1 and name in ("bert", "t5"):
+        # ADVICE r5 carry-forward: BERT/T5 padding masks are dense
+        # (b, 1, s, s) tensors with no packed-document {'doc_start'}
+        # equivalent, and ring attention (the only cp>1 attention path)
+        # cannot serve a dense mask. The old behavior dead-ended
+        # MID-FORWARD (models/attention.py raises on the first masked
+        # layer) — reject HERE, at config construction, with the
+        # alternatives instead.
+        raise SystemExit(
+            f"--context_parallel_size {cp} with --model_name {name}: "
+            "BERT/T5-style padding masks are dense attention masks, "
+            "which context parallelism cannot shard (ring attention has "
+            "no dense-mask path, and a gathered fallback would silently "
+            "lose the memory scaling cp exists for). Use "
+            "--context_parallel_size 1 for this model family, or move "
+            "the parallelism to --tensor_model_parallel_size / "
+            "--pipeline_model_parallel_size / data parallel "
+            "(docs/GUIDE.md, 'Masks')."
+        )
     dp = args.data_parallel_size
     if dp is None:
         dp = max(1, len(jax.devices()) // (tp * pp * cp))

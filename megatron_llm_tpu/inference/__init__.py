@@ -13,3 +13,6 @@ from megatron_llm_tpu.inference.engine import (  # noqa: F401
     EngineRequest,
     QueueFull,
 )
+from megatron_llm_tpu.inference.prefix_cache import (  # noqa: F401
+    PrefixCache,
+)

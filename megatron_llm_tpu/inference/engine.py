@@ -55,12 +55,39 @@ Scheduling is host-driven (one device scan per loop iteration) because
 admission IS a host decision; the dense engine's while_loop stays the
 right tool for single-shot batch eval (docs/GUIDE.md, "when the dense
 kernel still wins").
+
+ISSUE 6 adds three compounding serving features on the same pool:
+
+- **Prefix sharing** (`prefix_cache=True`, inference/prefix_cache.py):
+  admission looks the prompt up in a refcounted page-aligned prefix
+  index and maps cache-hit pages into the slot's page table instead of
+  re-prefilling them — chunked prefill resumes at the first uncached
+  token (mid-page divergence rides a copy-on-write page copy). Pages
+  free-list only at refcount zero; unreferenced cached prefixes evict
+  LRU under pool pressure. Requires chunked admission (the suffix
+  prefill must attend to pooled context, which the whole-prompt dense
+  prefill cannot).
+- **Token streaming** (`submit(..., stream=True)`): every generated
+  token is pushed to a per-request queue as it is booked, closed with a
+  None sentinel at completion/failure — the HTTP layer's SSE feed
+  (inference/server.py). `cancel()` retires an abandoned request's slot
+  mid-flight and reclaims its pages (refcounts intact).
+- **Speculative decoding** (`spec_decode_k>0`): a prompt-lookup n-gram
+  drafter proposes up to k tokens per greedy slot; one width-(k+1)
+  ragged chunk per slot verifies them (the prefill kernel's
+  arbitrary-start chunks ARE the verification shape). Accepted runs
+  keep bitwise greedy parity — every emitted token is the same
+  `_greedy_pick` the decode scan would have made; rejection rolls the
+  slot's host-authoritative length back, so stale K/V past the accepted
+  position is overwritten by the next round's writes and never read
+  (the kernels mask by length).
 """
 
 from __future__ import annotations
 
 import collections
 import logging
+import queue as queue_mod
 import threading
 import time
 from dataclasses import dataclass, field
@@ -71,6 +98,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from megatron_llm_tpu.inference.generation import bucket_prefill_len
+from megatron_llm_tpu.inference.prefix_cache import PrefixCache
 from megatron_llm_tpu.inference.sampling import (
     NEG_INF,
     modify_logits_for_top_p,
@@ -159,6 +187,15 @@ class EngineRequest:
     # to the pool instead of being held by a client that gave up.
     deadline_s: Optional[float] = None
 
+    # streaming: when submit(stream=True), every GENERATED token id is
+    # put here as it is booked; a None sentinel closes the stream at
+    # completion, failure, timeout, or cancel (the SSE layer's feed)
+    stream_q: Optional["queue_mod.SimpleQueue"] = None
+    # set by DecodeEngine.cancel() (e.g. the HTTP client disconnected
+    # mid-stream); the scheduler reaps it next round — queued requests
+    # fail immediately, running slots retire and reclaim their pages
+    cancelled: bool = False
+
     tokens: List[int] = field(default_factory=list)
     log_probs: List[float] = field(default_factory=list)
     error: Optional[str] = None
@@ -196,8 +233,25 @@ class _Slot:
     generated: int = 0
     sample_step: int = 0
     # chunked admission: next prompt position to prefill (the resumable
-    # saved offset); == len(req.prompt) once prefill is complete
+    # saved offset); == len(req.prompt) once prefill is complete.
+    # Prefix sharing starts it at the matched-token count: cache-hit
+    # positions never prefill.
     prefill_pos: int = 0
+    # prefix cache: how many full prompt pages of this slot are already
+    # registered (or were mapped shared at admission); registration
+    # advances as prefill passes each page boundary
+    registered: int = 0
+    # speculative drafting: bigram -> up to the 8 most recent start
+    # indices in req.tokens, maintained INCREMENTALLY (amortized O(1)
+    # per booked token — a per-round rescan of a long history would
+    # erode the latency spec decoding buys). Multiple occurrences are
+    # kept because on short-period repetition the NEWEST one sits at
+    # the sequence tail with an empty continuation — an older one is
+    # what actually drafts. `bigram_next` is the next start index to
+    # fold in; the FINAL bigram stays unindexed so a lookup never
+    # matches the occurrence it is extending.
+    bigram: dict = field(default_factory=dict)
+    bigram_next: int = 0
 
     @property
     def prefilling(self) -> bool:
@@ -366,6 +420,95 @@ def _make_prefill_fn(model, prefill_len, page_size):
     return jax.jit(prefill, donate_argnums=(1, 2))
 
 
+def _make_spec_step_fn(model, vocab_size, width, all_greedy):
+    """The jitted SPECULATIVE verification step, traced once per
+    (engine, width = spec_decode_k + 1, greedy specialization): every
+    live slot contributes one ragged chunk through the chunked paged
+    stack — a spec slot's chunk is [its next token (decided from the
+    carried last_logits exactly like a decode row), then its draft
+    tokens], a non-spec slot a plain width-1 decode row. The forward
+    writes K/V for every chunk position and returns logits per
+    position; verification is ON DEVICE: the greedy target at chunk
+    position j (`_greedy_pick`, the ONE token-decision definition) is
+    compared with the draft at position j+1, and the accepted count is
+    the leading run of matches. The carried logits come from the
+    ACCEPTED position — so a rejection "rolls back" by simply not
+    advancing past it; the host mirrors lengths to first+accepted and
+    the next round's writes overwrite the stale K/V (never read: the
+    kernels mask by length). Every emitted token is bitwise the token
+    the decode scan would have produced, because both paths share
+    `_greedy_pick` and per-position compute is row-independent.
+
+    Returns per-slot (first token, its logprob), the per-position
+    greedy targets + their logprobs (the accepted tokens' stream
+    values), the accepted counts, the new last logits (preserved for
+    idle slots), and the donated pools."""
+
+    def step(dec_params, pools_k, pools_v, page_table, lengths,
+             last_logits, chunk_tokens, chunk_lens, is_spec, greedy,
+             temperature, top_k, top_p, seeds, sample_steps):
+        active = chunk_lens > 0
+        lp_full = jax.nn.log_softmax(
+            last_logits.astype(jnp.float32), axis=-1)
+        if all_greedy:
+            sampled = _greedy_pick(last_logits, vocab_size)
+        else:
+            sampled = _per_slot_sample(
+                last_logits, greedy, temperature, top_k, top_p, seeds,
+                sample_steps, vocab_size)
+        first = jnp.where(active, sampled, 0)
+        first_lp = jnp.take_along_axis(
+            lp_full, first[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        toks = chunk_tokens.at[:, 0].set(first)
+        caches = {"k_pages_layers": pools_k, "v_pages_layers": pools_v,
+                  "page_table": page_table, "lengths": lengths,
+                  "chunk_lens": chunk_lens}
+        logits, new_caches = model.forward(
+            dec_params, toks, kv_caches=caches,
+            position_ids=lengths[:, None] + jnp.arange(width)[None, :],
+        )
+        n = logits.shape[0]
+        V = logits.shape[-1]
+        gt = _greedy_pick(logits.reshape(n * width, V),
+                          vocab_size).reshape(n, width)
+        glp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        gt_lp = jnp.take_along_axis(
+            glp, gt[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        # accepted run: draft at position j+1 matches the greedy target
+        # of position j, leading matches only, within the chunk's valid
+        # length
+        pos = jnp.arange(1, width)[None, :]
+        matches = (toks[:, 1:] == gt[:, :-1]) & (pos < chunk_lens[:, None])
+        acc = jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1),
+                      axis=1)
+        acc = jnp.where(is_spec, acc, 0)
+        last_idx = jnp.where(
+            is_spec, acc, jnp.clip(chunk_lens - 1, 0, width - 1))
+        new_last = jnp.take_along_axis(
+            logits, last_idx[:, None, None], axis=1)[:, 0]
+        new_last = jnp.where(active[:, None], new_last, last_logits)
+        return (first, first_lp, gt, gt_lp, acc, new_last,
+                new_caches["k_pages_layers"],
+                new_caches["v_pages_layers"])
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def _make_page_copy_fn():
+    """One jitted whole-page pool copy (the prefix cache's
+    copy-on-write): page `dst` becomes a private replica of shared page
+    `src` across every layer's K and V pool. src/dst are traced
+    scalars — one executable serves every COW. The read-before-write
+    data dependency orders it against any later scatter into `dst`."""
+
+    def copy(pools_k, pools_v, src, dst):
+        pools_k = tuple(pk.at[dst].set(pk[src]) for pk in pools_k)
+        pools_v = tuple(pv.at[dst].set(pv[src]) for pv in pools_v)
+        return pools_k, pools_v
+
+    return jax.jit(copy, donate_argnums=(0, 1))
+
+
 class DecodeEngine:
     """Fixed-slot continuous-batching decode engine over a paged pool.
 
@@ -399,6 +542,20 @@ class DecodeEngine:
       executables for the configured buckets at `start()` so the first
       request doesn't eat the compile stall (opt-in; warmup rounds run
       every slot idle, so they only scribble the dead null page).
+    - `prefix_cache`: share prompt-prefix K/V pages across requests
+      (inference/prefix_cache.py; page-aligned hash index, COW on
+      mid-page divergence, refcounted free-list returns, LRU eviction
+      under pool pressure). Requires chunked admission
+      (prefill_chunk_tokens > 0): the suffix prefill must attend to
+      pooled context. Requests with return_log_probs bypass matching
+      (their PROMPT logprobs require the full forward) but still
+      register their pages for others.
+    - `spec_decode_k`: speculative decoding — a prompt-lookup n-gram
+      drafter proposes up to k tokens per greedy slot per round,
+      verified in one width-(k+1) ragged chunk (ONE executable per
+      greedy specialization). Greedy token streams stay bitwise;
+      sampled slots ride the same round as plain decode rows. 0
+      disables.
 
     Pages are reserved UP FRONT at admission for the request's whole
     prompt + tokens_to_generate reach, so a running request can never
@@ -412,6 +569,8 @@ class DecodeEngine:
                  step_horizon: int = 8,
                  prefill_chunk_tokens: int = 256,
                  warmup_compile: bool = False,
+                 prefix_cache: bool = False,
+                 spec_decode_k: int = 0,
                  termination_id: Optional[int] = None,
                  vocab_size: Optional[int] = None, timers=None):
         assert max_context % page_size == 0, \
@@ -438,6 +597,16 @@ class DecodeEngine:
             prefill_chunk_tokens = max_context
         self.prefill_chunk_tokens = prefill_chunk_tokens
         self.warmup_compile = warmup_compile
+        if prefix_cache and not prefill_chunk_tokens:
+            raise ValueError(
+                "prefix_cache requires chunked admission "
+                "(prefill_chunk_tokens > 0): a cache-hit suffix prefill "
+                "must attend to pooled prefix K/V, which the whole-prompt "
+                "dense prefill cannot — enable chunking or disable the "
+                "prefix cache")
+        self._prefix = PrefixCache(page_size) if prefix_cache else None
+        assert spec_decode_k >= 0
+        self.spec_decode_k = spec_decode_k
         self.termination_id = termination_id
         self.vocab_size = vocab_size
         self.timers = timers
@@ -467,6 +636,12 @@ class DecodeEngine:
 
         self._step_fns: dict = {}  # horizon bucket -> jitted scan
         self._mixed_fns: dict = {}  # (width bucket, greedy) -> jitted
+        # spec verification executables: ONE width (spec_decode_k + 1)
+        # per greedy specialization — shorter drafts pad via chunk_lens,
+        # so traffic can never mint per-draft-length buckets
+        # (tests/test_spec_decode.py pins the count)
+        self._spec_fns: dict = {}  # (width, greedy) -> jitted
+        self._copy_fn = _make_page_copy_fn()
         # whole-prompt prefill executables, LRU-bounded like the pp
         # decode cache (api.py _pp_decode_fn): prompt buckets are an
         # unbounded key space across traffic
@@ -480,6 +655,12 @@ class DecodeEngine:
         self._steps = 0
         self._tokens_out = 0
         self._prefill_tokens = 0
+        self._cancelled = 0  # cancel() reaps (disconnected streams)
+        # speculative decoding accounting: proposed vs accepted draft
+        # tokens (the acceptance-rate gauge) and spec rounds run
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._t0 = time.perf_counter()
         # recent-window latency gauges: submit -> first generated token
         # per request, and wall ms per decode-token advance per round
@@ -501,6 +682,7 @@ class DecodeEngine:
                return_log_probs: bool = False,
                use_eod_for_early_termination: bool = True,
                deadline_s: Optional[float] = None,
+               stream: bool = False,
                ) -> EngineRequest:
         """Queue one request. Raises ValueError when it cannot ever fit
         (prompt + generation past max_context) and QueueFull when the
@@ -510,7 +692,13 @@ class DecodeEngine:
         exceeded, the request's waiter fails with TimeoutError and —
         when it was running — its slot retires and the pages return to
         the free list, so an abandoned request can never pin pool
-        capacity or wedge the FIFO head forever."""
+        capacity or wedge the FIFO head forever.
+
+        `stream=True` attaches a per-request token queue
+        (`req.stream_q`): every generated token id is pushed as it is
+        booked, and a None sentinel closes the stream on completion OR
+        failure — consumers must treat the sentinel, not result(), as
+        end-of-stream, then call result() for the final status."""
         total = len(prompt) + tokens_to_generate
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -545,6 +733,7 @@ class DecodeEngine:
             return_log_probs=return_log_probs,
             use_eod_for_early_termination=use_eod_for_early_termination,
             deadline_s=deadline_s,
+            stream_q=queue_mod.SimpleQueue() if stream else None,
         )
         req.t_submit = time.perf_counter()
         with self._lock:
@@ -556,6 +745,40 @@ class DecodeEngine:
             self._queue.append(req)
             self._work.notify()
         return req
+
+    @staticmethod
+    def _finish(req: EngineRequest):
+        """The ONE completion point: wake the waiter and close the
+        token stream (None sentinel) so an SSE consumer can never hang
+        on a request that already failed/retired."""
+        req.done.set()
+        if req.stream_q is not None:
+            req.stream_q.put(None)
+
+    def cancel(self, req: EngineRequest):
+        """Abandon a request (e.g. its streaming client disconnected):
+        queued requests fail their waiter immediately; a running one is
+        flagged and reaped by the scheduler's next round — the slot
+        retires and its pages return/release exactly like a normal
+        retirement, so shared-prefix refcounts stay intact. Idempotent;
+        a no-op on requests that already finished."""
+        with self._lock:
+            if req.done.is_set():
+                return
+            req.cancelled = True
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                # not queued: running (the serve loop reaps it) or
+                # being admitted right now (ditto, next round)
+                self._work.notify()
+                return
+            # inside the lock: the serve thread increments this counter
+            # too (running-slot reap), and a racing unlocked += would
+            # drop counts under concurrent disconnects
+            self._cancelled += 1
+        req.error = f"request {req.rid} cancelled"
+        self._finish(req)
 
     _PREFILL_CACHE_CAP = 8
 
@@ -600,27 +823,74 @@ class DecodeEngine:
                 req = self._queue[0]
                 need = -(-(len(req.prompt) + req.tokens_to_generate)
                          // self.page_size)
-                if len(self._free_pages) < need:
+                # prefix sharing: cache-hit pages map into the page
+                # table instead of being allocated + prefilled.
+                # return_log_probs requests bypass MATCHING (their
+                # prompt logprobs need the full forward) but still
+                # register their pages below for later requests.
+                match = None
+                if self._prefix is not None and not req.return_log_probs:
+                    match = self._prefix.lookup(req.prompt)
+                    if match.matched == 0:
+                        match = None
+                need_new = need - (match.full_pages if match else 0)
+                if match is not None:
+                    # pin the hit (incl. the COW source) BEFORE any
+                    # eviction below could free it out from under us
+                    self._prefix.acquire(match)
+                if len(self._free_pages) < need_new \
+                        and self._prefix is not None:
+                    # reclaim unreferenced cached prefixes (LRU) before
+                    # blocking the FIFO head on pages
+                    self._free_pages.extend(self._prefix.evict(
+                        need_new - len(self._free_pages)))
+                if len(self._free_pages) < need_new:
+                    if match is not None:
+                        self._prefix.unacquire(match)
                     return prefilled
                 self._queue.popleft()
                 # claim the slot INSIDE the lock: stop(drain=True) polls
                 # "queue empty and no slot busy" — a request must never
                 # be invisible to that check between dequeue and prefill
                 slot.req = req
-            pages = [self._free_pages.pop() for _ in range(need)]
+            fresh = [self._free_pages.pop() for _ in range(need_new)]
+            pages = (list(match.pages) if match is not None else []) + fresh
             self._pt[si] = 0
             self._pt[si, :need] = pages
             slot.pages = pages
             slot.generated = 0
             slot.sample_step = 0
+            slot.registered = match.full_pages if match is not None else 0
+            slot.bigram = {}
+            slot.bigram_next = 0
             req.tokens = list(req.prompt)
             if self.prefill_chunk_tokens:
-                # chunked admission: no device work here — the prompt
-                # prefills incrementally through the mixed rounds,
-                # resumable at slot.prefill_pos
-                slot.prefill_pos = 0
+                # chunked admission: no device work here beyond the COW
+                # copy — the prompt suffix prefills incrementally
+                # through the mixed rounds, resumable at
+                # slot.prefill_pos (== the matched-token count: cache-
+                # hit positions never prefill)
+                matched = 0
+                if match is not None:
+                    matched = match.matched
+                    if match.cow_src is not None:
+                        # copy-on-write: the divergent page starts as a
+                        # private replica of the shared page; prefill
+                        # resumes at the divergence offset inside it,
+                        # so the shared page never sees this request's
+                        # writes
+                        self._pools_k, self._pools_v = self._copy_fn(
+                            self._pools_k, self._pools_v,
+                            jnp.asarray(match.cow_src, jnp.int32),
+                            jnp.asarray(pages[match.full_pages],
+                                        jnp.int32))
+                        self._prefix.release_page(match.cow_src)
+                        self._prefix.cow_copies += 1
+                if self._prefix is not None:
+                    self._prefix.note(len(req.prompt), matched)
+                slot.prefill_pos = matched
                 slot.forced = collections.deque()
-                self._lengths[si] = 0
+                self._lengths[si] = matched
             else:
                 plen = bucket_prefill_len(len(req.prompt))
                 self._pools_k, self._pools_v, row_logits, plp = \
@@ -645,15 +915,25 @@ class DecodeEngine:
 
     def _retire(self, si: int):
         slot = self._slots[si]
-        self._free_pages.extend(slot.pages)
+        if self._prefix is None:
+            self._free_pages.extend(slot.pages)
+        else:
+            # refcounted returns: registered/shared pages stay with the
+            # cache (evictable once unreferenced); only untracked pages
+            # (generated tokens, partial prompt tails, lost insert
+            # races) go straight back to the free list
+            for pg in slot.pages:
+                if not self._prefix.release(pg):
+                    self._free_pages.append(pg)
         slot.pages = []
+        slot.registered = 0
         self._pt[si] = 0
         self._lengths[si] = 0
         req = slot.req
         slot.req = None
         req.t_done = time.perf_counter()
         self._retired += 1
-        req.done.set()
+        self._finish(req)
 
     # -- the decode loop ---------------------------------------------------
 
@@ -688,6 +968,8 @@ class DecodeEngine:
         s = self._slots[i]
         r = s.req
         r.tokens.append(tok)
+        if r.stream_q is not None:
+            r.stream_q.put(tok)
         s.generated += 1
         s.sample_step += 1
         self._tokens_out += 1
@@ -724,10 +1006,25 @@ class DecodeEngine:
                        f"{r.deadline_s} while queued")
             r.timed_out = True
             self._timed_out += 1
-            r.done.set()
+            self._finish(r)
         for i, s in enumerate(self._slots):
             r = s.req
-            if r is not None and r.expired(now):
+            if r is None:
+                continue
+            if r.cancelled:
+                # cancel() mid-flight (e.g. streaming client gone):
+                # retire exactly like a completion — pages return or
+                # release through the refcounted path, shared-prefix
+                # refcounts stay intact
+                r.error = (f"request {r.rid} cancelled after "
+                           f"{len(r.tokens) - len(r.prompt)}"
+                           f"/{r.tokens_to_generate} generated tokens; "
+                           f"slot retired, pages reclaimed")
+                with self._lock:  # cancel() (HTTP thread) bumps it too
+                    self._cancelled += 1
+                self._retire(i)
+                continue
+            if r.expired(now):
                 r.error = (f"request {r.rid} exceeded deadline_s="
                            f"{r.deadline_s} after {len(r.tokens) - len(r.prompt)}"
                            f"/{r.tokens_to_generate} generated tokens; "
@@ -760,6 +1057,11 @@ class DecodeEngine:
                 if dec_steps:
                     self._decode_ms.append(dt_ms)
             return True
+        if self.spec_decode_k:
+            drafts = self._collect_drafts()
+            if drafts:
+                self._spec_round(drafts, t0, admit_prefilled)
+                return True
         return self._decode_round(t0, admit_prefilled)
 
     def _decode_round(self, t0: float, prefill_tokens: int = 0) -> bool:
@@ -919,6 +1221,9 @@ class DecodeEngine:
                     float(x) for x in chunk_lps[:ln - 1])
         s_c.prefill_pos += ln
         self._lengths[ci] += ln
+        # every prompt page this chunk completed becomes a shareable
+        # cache entry (no-op without the prefix cache)
+        self._register_prefix(ci)
 
         # decode slots: one token each, the scan-path bookkeeping at
         # horizon 1
@@ -931,10 +1236,228 @@ class DecodeEngine:
             self._book_token(i, int(first[i]), now)
         return len(dec), ln
 
+    # -- prefix sharing ----------------------------------------------------
+
+    def _register_prefix(self, si: int) -> None:
+        """Register every COMPLETED full prompt page of slot `si` in
+        the prefix cache (called as chunked prefill passes each page
+        boundary): a later request sharing the prefix hits these pages
+        even while this one is still mid-flight. Only pages whose
+        tokens are ENTIRELY prompt are registered — a page that also
+        receives decode writes is request-specific. Shared pages mapped
+        at admission arrive pre-counted in slot.registered; an insert
+        lost to a concurrent identical prefill leaves the page
+        untracked (free-listed at retirement), never double-indexed."""
+        if self._prefix is None:
+            return
+        s = self._slots[si]
+        r = s.req
+        ps = self.page_size
+        limit = min(s.prefill_pos, len(r.prompt))
+        while (s.registered + 1) * ps <= limit:
+            pg = int(self._pt[si, s.registered])
+            self._prefix.insert(r.prompt[: (s.registered + 1) * ps], pg)
+            s.registered += 1
+
+    # -- speculative decoding ----------------------------------------------
+
+    def _spec_fn(self, width, all_greedy):
+        key = (width, all_greedy)
+        if key not in self._spec_fns:
+            self._spec_fns[key] = _make_spec_step_fn(
+                self.model, self.vocab_size, width, all_greedy)
+        return self._spec_fns[key]
+
+    def _draft(self, si: int) -> List[int]:
+        """Prompt-lookup (n-gram) drafter: find the most recent earlier
+        occurrence of the request's trailing bigram in its own tokens
+        (prompt + generated) and propose the continuation — free to
+        compute, surprisingly effective on prompts the answer quotes
+        (the Saxena prompt-lookup result). Greedy slots only: sampled
+        verification would need rejection-sampling machinery for
+        distribution parity. Drafts are capped so the verify chunk
+        never writes a position past the request's reserved prompt +
+        tokens_to_generate page reach."""
+        s = self._slots[si]
+        r = s.req
+        if not r.greedy:
+            return []
+        cap = min(self.spec_decode_k,
+                  r.tokens_to_generate - s.generated - 1)
+        if cap <= 0:
+            return []
+        toks = r.tokens
+        if len(toks) < 3:
+            return []
+        # fold newly-booked tokens into the bigram index; every start
+        # j <= len-3 is interior (the trailing bigram at len-2 stays
+        # out, or the lookup below would match itself)
+        while s.bigram_next <= len(toks) - 3:
+            j = s.bigram_next
+            occ = s.bigram.setdefault((toks[j], toks[j + 1]), [])
+            occ.append(j)
+            if len(occ) > 8:
+                del occ[0]
+            s.bigram_next += 1
+        # position len(toks) is decided by the carried logits inside
+        # the round, so the continuation shifts by one: drafts cover
+        # the positions after it. Prefer the newest occurrence whose
+        # continuation fills the cap; on short-period repetition the
+        # newest ones sit at the tail with truncated continuations, so
+        # fall back to the longest available.
+        occ = s.bigram.get((toks[-2], toks[-1]))
+        if not occ:
+            return []
+        best_j, best_avail = None, 0
+        for j in reversed(occ):
+            avail = len(toks) - (j + 3)
+            if avail >= cap:
+                best_j, best_avail = j, avail
+                break
+            if avail > best_avail:
+                best_j, best_avail = j, avail
+        if best_j is None:
+            return []
+        return list(toks[best_j + 3: best_j + 3 + cap])
+
+    def _collect_drafts(self) -> dict:
+        """Drafts for every eligible live slot; empty dict means 'run a
+        plain decode round'. No spec round while any slot still owes
+        teacher-forced prompt tokens (whole-prompt mode's post-bucket
+        tail): the spec step has no forcing machinery, and a sampled
+        token where a forced one belongs would corrupt the stream."""
+        if any(s.req is not None and s.forced for s in self._slots):
+            return {}
+        drafts = {}
+        for i, s in enumerate(self._slots):
+            if s.req is None:
+                continue
+            d = self._draft(i)
+            if d:
+                drafts[i] = d
+        return drafts
+
+    def _spec_round(self, drafts: dict, t0: float,
+                    prefill_tokens: int = 0) -> None:
+        """One speculative round: every live slot contributes a ragged
+        chunk — spec slots [next token + draft run], the rest plain
+        width-1 decode rows — through ONE jitted width-(k+1) dispatch.
+        The device verifies drafts against its own greedy targets
+        (_make_spec_step_fn); the host books the first token plus the
+        accepted run and rolls the slot's length mirror forward by
+        exactly the booked count, which IS the rejection rollback (the
+        next round's writes overwrite stale K/V past it)."""
+        width = self.spec_decode_k + 1
+        n = self.slots
+        live = [i for i, s in enumerate(self._slots) if s.req is not None]
+        chunk_tokens = np.zeros((n, width), np.int32)
+        chunk_lens = np.zeros((n,), np.int32)
+        is_spec = np.zeros((n,), bool)
+        greedy = np.ones(n, bool)
+        temperature = np.ones(n, np.float32)
+        top_k = np.zeros(n, np.int32)
+        top_p = np.zeros(n, np.float32)
+        seeds = np.zeros(n, np.uint32)
+        sample_steps = np.zeros(n, np.int32)
+        for i in live:
+            s = self._slots[i]
+            r = s.req
+            d = drafts.get(i, [])
+            if d:
+                chunk_tokens[i, 1:1 + len(d)] = d
+            chunk_lens[i] = 1 + len(d)
+            is_spec[i] = bool(d)
+            greedy[i] = r.greedy
+            temperature[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            seeds[i] = np.uint32(r.seed & 0xFFFFFFFF)
+            sample_steps[i] = s.sample_step
+        all_greedy = all(self._slots[i].req.greedy for i in live)
+        (first, first_lp, gt, gt_lp, acc, new_last, self._pools_k,
+         self._pools_v) = self._spec_fn(width, all_greedy)(
+            self._dec_params, self._pools_k, self._pools_v,
+            jnp.asarray(self._pt), jnp.asarray(self._lengths),
+            self._last_logits, jnp.asarray(chunk_tokens),
+            jnp.asarray(chunk_lens), jnp.asarray(is_spec),
+            jnp.asarray(greedy), jnp.asarray(temperature),
+            jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(seeds), jnp.asarray(sample_steps),
+        )
+        self._last_logits = new_last
+        first = np.asarray(first)
+        first_lp = np.asarray(first_lp)
+        gt = np.asarray(gt)
+        gt_lp = np.asarray(gt_lp)
+        acc = np.asarray(acc)
+        self._steps += 1
+        self._spec_rounds += 1
+
+        now = time.perf_counter()
+        emitted_total = 0
+        for i in live:
+            r = self._slots[i].req
+            d_n = int(chunk_lens[i]) - 1
+            a = int(acc[i]) if d_n else 0
+            self._spec_proposed += d_n
+            # the round's first token (decided from the carried logits,
+            # exactly a decode row), then the accepted draft run — each
+            # accepted token IS the greedy target the decode scan would
+            # have produced at that position
+            emit = [(int(first[i]), float(first_lp[i]))]
+            emit += [(int(gt[i, j]), float(gt_lp[i, j]))
+                     for j in range(a)]
+            booked = 0
+            for tok, lp in emit:
+                self._lengths[i] += 1
+                if r.return_log_probs:
+                    r.log_probs.append(lp)
+                booked += 1
+                if self._book_token(i, tok, now):
+                    break  # eod/budget: stale chunk tail never books
+            emitted_total += booked
+            # acceptance gauge counts only draft tokens actually BOOKED
+            # (booked minus the first decode-row token): eod/budget can
+            # retire the slot mid-run, and the unbooked accepted tail
+            # must not inflate serve_spec_accept_rate — operators read
+            # that gauge to decide whether spec decode pays for itself
+            self._spec_accepted += booked - 1
+
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        per_advance = dt_ms * len(live) / max(emitted_total, 1)
+        with self._lock:  # counters() reads these windows concurrently
+            # prefill_tokens: whole-prompt-mode _admit() ran its device
+            # prefill inside this round's wall time (the _decode_round
+            # contract) — the audit trail must carry it here too
+            self._round_log.append({
+                "prefill_tokens": prefill_tokens, "decode_steps": 1,
+                "decode_slots": len(live), "ms": dt_ms,
+                "spec_emitted": emitted_total})
+            # per decode-token advance: one spec round advances
+            # emitted/live tokens per slot
+            self._decode_ms.append(per_advance)
+
     def drain(self):
         """Run until the queue and every slot are empty."""
         while self.step():
             pass
+
+    def reset_prefix_cache(self):
+        """Drop every cached prefix and return its pages to the free
+        list. Only legal on an IDLE engine (no live slots): a live slot
+        holding refcounted shared pages would double-free them at
+        retirement once the owning cache is gone. Benchmarks use this
+        to measure a cold cache on a compile-warmed engine."""
+        if self._prefix is None:
+            return
+        busy = [i for i, s in enumerate(self._slots) if s.req is not None]
+        if busy:
+            raise RuntimeError(
+                f"reset_prefix_cache on a busy engine (slots {busy} "
+                f"live): drain() first")
+        self._free_pages.extend(self._prefix.evict(self.num_pages))
+        assert self._prefix.cached_pages == 0
+        self._prefix = PrefixCache(self.page_size)
 
     # -- background serve loop --------------------------------------------
 
@@ -946,7 +1469,7 @@ class DecodeEngine:
             self._queue.clear()
         for req in pending:
             req.error = msg
-            req.done.set()
+            self._finish(req)
         for i, s in enumerate(self._slots):
             if s.req is not None:
                 s.req.error = msg
@@ -1008,6 +1531,22 @@ class DecodeEngine:
                     jnp.asarray(np.zeros(n, np.uint32)),
                     jnp.asarray(zeros_i),
                 )
+        if self.spec_decode_k:
+            w = self.spec_decode_k + 1
+            (_, _, _, _, _, _, self._pools_k, self._pools_v) = \
+                self._spec_fn(w, True)(
+                self._dec_params, self._pools_k, self._pools_v,
+                null_pt, jnp.asarray(zeros_i), self._last_logits,
+                jnp.asarray(np.zeros((n, w), np.int32)),
+                jnp.asarray(zeros_i),
+                jnp.asarray(np.zeros(n, bool)),
+                jnp.asarray(np.ones(n, bool)),
+                jnp.asarray(np.ones(n, np.float32)),
+                jnp.asarray(zeros_i),
+                jnp.asarray(np.zeros(n, np.float32)),
+                jnp.asarray(np.zeros(n, np.uint32)),
+                jnp.asarray(zeros_i),
+            )
 
     def start(self):
         assert self._thread is None, "engine already started"
@@ -1100,7 +1639,7 @@ class DecodeEngine:
             # must never die mid-traffic
             ttft = list(self._ttft_ms)
             decode_ms = list(self._decode_ms)
-        return {
+        out = {
             "serve_slot_occupancy": occupied / self.slots,
             "serve_queue_depth": len(self._queue),
             "serve_pages_in_use": self.num_pages - 1
@@ -1109,6 +1648,7 @@ class DecodeEngine:
             "serve_admitted": self._admitted,
             "serve_retired": self._retired,
             "serve_timed_out": self._timed_out,
+            "serve_cancelled": self._cancelled,
             "serve_steps": self._steps,
             "serve_tok_s": round(self._tokens_out / dt, 2),
             "serve_prefill_tokens": self._prefill_tokens,
@@ -1116,6 +1656,19 @@ class DecodeEngine:
             "serve_ttft_p95_ms": round(self._pct(ttft, 0.95), 2),
             "serve_decode_p95_ms": round(self._pct(decode_ms, 0.95), 2),
         }
+        if self._prefix is not None:
+            # hit-rate / shared-page / COW / eviction gauges
+            # (prefix_cache.PrefixCache.stats), serve_-prefixed into the
+            # one counters schema /metrics and the timers export share
+            for k, v in self._prefix.stats().items():
+                out["serve_" + k] = v
+        if self.spec_decode_k:
+            out["serve_spec_rounds"] = self._spec_rounds
+            out["serve_spec_proposed"] = self._spec_proposed
+            out["serve_spec_accepted"] = self._spec_accepted
+            out["serve_spec_accept_rate"] = round(
+                self._spec_accepted / max(self._spec_proposed, 1), 4)
+        return out
 
     def export_gauges(self, timers=None):
         timers = timers if timers is not None else self.timers

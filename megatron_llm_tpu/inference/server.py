@@ -29,11 +29,21 @@ path can take traffic, 503 once the engine's serve loop died poisoned
 (`DecodeEngine._broken`) or its thread stopped, with the engine's
 liveness snapshot (alive / broken / queue_depth / slots_busy) as the
 body. Engineless servers always answer 200.
+
+SSE token streaming (ISSUE 6): a PUT with `{"stream": true}` (exactly
+one prompt, engine path only) answers `text/event-stream` — one `data:`
+event per generated token, written the moment the engine books it, a
+final `{"done": ...}` event, then connection close (EOF = end of
+stream). Validation failures answer plain JSON before any bytes stream.
+A mid-stream client disconnect cancels the engine request: the slot
+retires and its pages return to the pool (prefix-cache refcounts
+intact). `stream_enabled=False` (`--no_stream`) turns the surface off.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -42,6 +52,8 @@ from megatron_llm_tpu.inference.api import (
     beam_search_and_post_process,
     generate_and_post_process,
 )
+
+_logger = logging.getLogger(__name__)
 
 GENERATE_NUM = 0
 BEAM_NUM = 1
@@ -54,7 +66,7 @@ class MegatronGenerate:
     """Request validation + dispatch (ref: MegatronGenerate :17-233)."""
 
     def __init__(self, model, params, tokenizer, engine=None,
-                 request_deadline_s=None):
+                 request_deadline_s=None, stream_enabled=True):
         self.model = model
         self.params = params
         self.tokenizer = tokenizer
@@ -63,10 +75,20 @@ class MegatronGenerate:
         # (DecodeEngine deadline semantics: expiry fails the waiter and
         # reclaims the slot's pages); None = no deadline
         self.request_deadline_s = request_deadline_s
+        # SSE token streaming ({"stream": true} PUTs) — gate for
+        # deployments that front this server with a buffering proxy
+        self.stream_enabled = stream_enabled
+        # incremental-detokenization window bound: pending tokens are
+        # re-decoded per event (SentencePiece spacing / split multi-byte
+        # correctness), and the window resets past this many tokens so
+        # long generations stay O(window) per token, not O(n)
+        self.stream_flush_tokens = 64
 
-    def put(self, raw: dict):
-        """Returns (payload, http_status); validation messages mirror the
-        reference byte for byte where applicable."""
+    def _validate(self, raw: dict):
+        """The ONE request-validation surface (shared by the buffered
+        and streaming paths): returns an (error_payload, http_status)
+        tuple on failure — messages mirror the reference byte for byte
+        where applicable — or a dict of parsed fields."""
         if "prompts" not in raw:
             return "prompts argument required", 400
         if "max_len" in raw:
@@ -136,6 +158,49 @@ class MegatronGenerate:
             if len(prompts) > 1:
                 return "When doing beam_search, batch size must be 1", 400
 
+        return {
+            "prompts": prompts,
+            "tokens_to_generate": tokens_to_generate,
+            "logprobs": logprobs,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "top_p_decay": top_p_decay,
+            "top_p_bound": top_p_bound,
+            "add_BOS": add_BOS,
+            "stop_on_eol": stop_on_eol,
+            "stop_on_double_eol": stop_on_double_eol,
+            "prevent_newline_after_colon": prevent_newline_after_colon,
+            "random_seed": random_seed,
+            "no_log": no_log,
+            "beam_width": beam_width,
+            "stop_token": stop_token,
+            "length_penalty": length_penalty,
+        }
+
+    def put(self, raw: dict):
+        """Returns (payload, http_status); validation messages mirror the
+        reference byte for byte where applicable."""
+        v = self._validate(raw)
+        if not isinstance(v, dict):
+            return v
+        prompts = v["prompts"]
+        tokens_to_generate = v["tokens_to_generate"]
+        logprobs = v["logprobs"]
+        temperature = v["temperature"]
+        top_k = v["top_k"]
+        top_p = v["top_p"]
+        top_p_decay = v["top_p_decay"]
+        top_p_bound = v["top_p_bound"]
+        add_BOS = v["add_BOS"]
+        stop_on_eol = v["stop_on_eol"]
+        stop_on_double_eol = v["stop_on_double_eol"]
+        prevent_newline_after_colon = v["prevent_newline_after_colon"]
+        random_seed = v["random_seed"]
+        beam_width = v["beam_width"]
+        stop_token = v["stop_token"]
+        length_penalty = v["length_penalty"]
+
         # continuous-batching dispatch: everything the engine speaks goes
         # through its queue (per-request knobs, slot-level admission); the
         # engine-ineligible residue (score-only, beam, pnac/top_p_decay)
@@ -202,6 +267,26 @@ class MegatronGenerate:
         finally:
             LOCK.release()
 
+    def _prompt_ids(self, prompt, add_BOS):
+        """ONE definition of prompt-id construction for the engine
+        paths (buffered + streaming)."""
+        ids = self.tokenizer.tokenize(prompt)
+        if add_BOS:
+            ids = [self.tokenizer.bos] + ids
+        return ids
+
+    @staticmethod
+    def _request_seed(random_seed, index=0):
+        """ONE definition of per-request seed derivation: a
+        non-negative random_seed is deterministic (decorrelated per
+        batch row by index — engine RNG is per request, not per batch
+        position), otherwise fresh OS entropy per request."""
+        if random_seed >= 0:
+            return random_seed + index
+        import os as _os
+
+        return int.from_bytes(_os.urandom(4), "little")
+
     def _put_engine(self, prompts, tokens_to_generate, logprobs, top_k,
                     top_p, temperature, add_BOS, random_seed):
         """Queue each prompt as one engine request and wait for all of
@@ -217,12 +302,7 @@ class MegatronGenerate:
         )
 
         tok = self.tokenizer
-        prompt_ids = []
-        for p in prompts:
-            ids = tok.tokenize(p)
-            if add_BOS:
-                ids = [tok.bos] + ids
-            prompt_ids.append(ids)
+        prompt_ids = [self._prompt_ids(p, add_BOS) for p in prompts]
         eng = self.engine
         pool_tokens = (eng.num_pages - 1) * eng.page_size
         if any(len(ids) + tokens_to_generate
@@ -232,14 +312,7 @@ class MegatronGenerate:
         reqs = []
         try:
             for i, ids in enumerate(prompt_ids):
-                if random_seed >= 0:
-                    seed = random_seed + i  # decorrelate rows, keep
-                    # request-level determinism (engine RNG is per
-                    # request, not per batch position)
-                else:
-                    import os as _os
-
-                    seed = int.from_bytes(_os.urandom(4), "little")
+                seed = self._request_seed(random_seed, i)
                 try:
                     reqs.append(self.engine.submit(
                         ids, tokens_to_generate,
@@ -279,6 +352,155 @@ class MegatronGenerate:
             }, 200
         except Exception as e:  # same jsonified-error contract (:230)
             return {"message": repr(e)}, 500
+
+    def put_stream(self, raw: dict, start_response, write_event):
+        """SSE token streaming for `{"stream": true}` PUTs (ISSUE 6):
+        exactly one prompt rides the engine queue with a per-request
+        token queue (`DecodeEngine.submit(stream=True)`), and every
+        generated token is written as one `data:` event the moment the
+        scheduler books it — chunked-prefill TTFT reaches the client
+        instead of dying in a buffered response.
+
+        Contract: returns an (error_payload, status) tuple while
+        nothing has been sent (the handler answers plain JSON); once
+        eligible it calls `start_response()` (the handler sends the 200
+        + `text/event-stream` headers), then `write_event(dict)` per
+        token, a final `{"done": ...}` event, and returns None. A
+        failing write (client disconnected mid-stream) CANCELS the
+        engine request — the slot retires and its pages return to the
+        pool with refcounts intact — and re-raises so the handler
+        drops the connection."""
+        if not self.stream_enabled:
+            return {"message": "token streaming is disabled "
+                               "(--no_stream)"}, 400
+        if self.engine is None:
+            return {"message": "token streaming requires the "
+                               "continuous-batching engine "
+                               "(--serving_slots > 0)"}, 400
+        v = self._validate(raw)
+        if not isinstance(v, dict):
+            return v
+        if len(v["prompts"]) != 1:
+            return {"message": "streaming serves exactly one prompt "
+                               "per request"}, 400
+        if v["tokens_to_generate"] < 1:
+            return {"message": "streaming requires tokens_to_generate "
+                               ">= 1"}, 400
+        if (v["beam_width"] is not None
+                or v["prevent_newline_after_colon"]
+                or v["top_p_decay"] != 0.0):
+            return {"message": "streaming supports only engine-path "
+                               "requests (no beam_width / "
+                               "prevent_newline_after_colon / "
+                               "top_p_decay)"}, 400
+        if v["logprobs"]:
+            # reject instead of silently dropping: the buffered engine
+            # path DOES return logprobs, and a stream that quietly
+            # omits them would be a lying API surface
+            return {"message": "streaming does not return logprobs; "
+                               "drop logprobs or use the buffered "
+                               "path"}, 400
+
+        import queue as _queue
+
+        from megatron_llm_tpu.inference.engine import QueueFull
+
+        # everything before start_response() must answer plain JSON —
+        # after it, the 200 is on the wire and errors can only arrive
+        # as a final event
+        try:
+            tok = self.tokenizer
+            ids = self._prompt_ids(v["prompts"][0], v["add_BOS"])
+            seed = self._request_seed(v["random_seed"])
+            req = self.engine.submit(
+                ids, v["tokens_to_generate"], top_k=v["top_k"],
+                top_p=v["top_p"], temperature=v["temperature"],
+                seed=seed, use_eod_for_early_termination=True,
+                deadline_s=self.request_deadline_s, stream=True,
+            )
+        except QueueFull:
+            return {"message": QUEUE_FULL_MSG}, 503
+        except ValueError as e:
+            # past the engine's max_context/pool: the whole-batch
+            # fallback cannot stream, so the honest answer is the limit
+            return {"message": repr(e)}, 400
+        except Exception as e:  # same jsonified-error contract as put()
+            return {"message": repr(e)}, 500
+
+        out_ids = []
+        # INCREMENTAL detokenization over a bounded tail window: decode
+        # the pending tokens and emit the suffix delta — a per-token
+        # detokenize would drop SentencePiece word-boundary spaces and
+        # mojibake multi-byte chars split across tokens, while decoding
+        # the FULL running sequence per token would be quadratic in
+        # generation length. A trailing U+FFFD is an unfinished byte
+        # sequence: hold it back until its continuation arrives. At the
+        # flush threshold the window resets keeping ONE overlap token,
+        # so the next window never starts at a bare piece boundary (the
+        # final done event's full-sequence text is authoritative
+        # regardless).
+        pending = []
+        win_emitted = ""
+        flush_at = self.stream_flush_tokens
+        try:
+            # from here on the request is live: ANY failure — including
+            # the client disconnecting before the headers flush — must
+            # cancel it, or the slot decodes every remaining token for
+            # a dead connection
+            start_response()
+            while True:
+                t = req.stream_q.get(timeout=600.0)
+                if t is None:
+                    break
+                out_ids.append(int(t))
+                pending.append(int(t))
+                cur = tok.detokenize(pending)
+                stable = cur
+                while stable.endswith("�"):
+                    stable = stable[:-1]
+                delta = ""
+                if stable.startswith(win_emitted):
+                    delta = stable[len(win_emitted):]
+                    win_emitted = stable
+                if len(pending) >= flush_at:
+                    if stable == cur:
+                        pending = pending[-1:]
+                        win_emitted = tok.detokenize(pending)
+                    elif len(pending) >= 4 * flush_at:
+                        # degenerate undecodable tail (e.g. byte-
+                        # fallback pieces that never complete): force
+                        # the reset anyway — bounded per-token cost
+                        # beats re-decoding the whole generation, and
+                        # the final event's text is authoritative
+                        pending = pending[-flush_at:]
+                        win_emitted = tok.detokenize(pending)
+                        while win_emitted.endswith("�"):
+                            win_emitted = win_emitted[:-1]
+                write_event({"token": int(t), "text": delta})
+        except _queue.Empty:
+            # stalled engine: reclaim the slot and tell the client
+            # before closing — an EOF with no done event looks like a
+            # transport bug, not a server decision
+            self.engine.cancel(req)
+            try:
+                write_event({"done": True,
+                             "error": "timed out waiting for the "
+                                      "engine; request cancelled"})
+            except Exception:
+                pass
+            return None
+        except Exception:
+            # the client went away mid-stream: reclaim the slot + pages
+            # NOW instead of decoding for a closed socket
+            self.engine.cancel(req)
+            raise
+        final = {"done": True, "tokens": list(out_ids)}
+        if req.error is not None:
+            final = {"done": True, "error": req.error}
+        else:
+            final["text"] = tok.detokenize(ids + out_ids)
+        write_event(final)
+        return None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -338,8 +560,53 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError:
             self._respond("invalid json", 400)
             return
+        if raw.get("stream"):
+            self._stream_put(raw)
+            return
         payload, status = self.generator.put(raw)
         self._respond(payload, status)
+
+    def _stream_put(self, raw):
+        """SSE dispatch: headers go out only once the request is
+        admitted to the engine queue (validation errors stay plain
+        JSON); each generated token is one `data:` event, flushed as it
+        books, and the connection closes after the final `done` event —
+        EOF is end-of-stream. A write failure means the client
+        disconnected: MegatronGenerate.put_stream has already cancelled
+        the engine request (slot retired, pages reclaimed); just drop
+        the connection."""
+
+        def start_response():
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+
+        def write_event(obj):
+            self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
+            self.wfile.flush()
+
+        try:
+            err = self.generator.put_stream(raw, start_response,
+                                            write_event)
+        except ConnectionError:
+            # client went away mid-stream (broken pipe / reset):
+            # put_stream already cancelled the engine request — nothing
+            # useful left to send on a dead socket
+            self.close_connection = True
+            return
+        except Exception:
+            # a server-side failure after the headers are on the wire
+            # reaches the client as a bare EOF with no done event — log
+            # it, or it is indistinguishable from a transport bug
+            _logger.exception("streaming PUT failed mid-stream")
+            self.close_connection = True
+            return
+        if err is not None:
+            self._respond(*err)
+        else:
+            self.close_connection = True
 
     def _respond(self, payload, status):
         body = (json.dumps(payload) if isinstance(payload, (dict, list))
@@ -367,11 +634,12 @@ class MegatronServer:
     `run` and gracefully drained by `stop`."""
 
     def __init__(self, model, params, tokenizer, engine=None,
-                 request_deadline_s=None):
+                 request_deadline_s=None, stream_enabled=True):
         self.engine = engine
         self.generator = MegatronGenerate(
             model, params, tokenizer, engine=engine,
-            request_deadline_s=request_deadline_s)
+            request_deadline_s=request_deadline_s,
+            stream_enabled=stream_enabled)
         self._httpd = None
 
     def run(self, host: str = "0.0.0.0", port: int = 5000,

@@ -484,12 +484,20 @@ def attention_block(
         if pctx is not None and pctx.cp > 1 and mask is not None:
             # LOUD refusal (was a silent gathered-attention fallback):
             # a dense mask under cp would force a full-sequence gather,
-            # quietly losing the memory scaling cp exists for.
+            # quietly losing the memory scaling cp exists for. The CLI
+            # path never gets here — args_to_configs rejects BERT/T5
+            # (padding-mask models, which have no doc_start form) at
+            # config construction; this guard catches direct library
+            # callers.
             raise ValueError(
                 "cp>1 with a dense attention mask: pass packed-document "
                 "masks as {'doc_start': (b, s)} (utils/masks.py "
                 "get_document_starts) to keep the sequence sharded, or "
-                "disable context parallelism for this model"
+                "disable context parallelism for this model. "
+                "BERT/T5-style PADDING masks have no doc_start "
+                "equivalent — those model families must run with cp=1 "
+                "(rejected at config construction on the CLI path; "
+                "docs/GUIDE.md 'Masks')"
             )
         if (pctx is not None and pctx.cp > 1 and doc_start is not None
                 and not no_dropout):

@@ -470,6 +470,64 @@ class TestScheduling:
             eng.submit([3, 4], 1)
 
 
+class TestStreamingAndCancel:
+    """ISSUE 6: the per-request token queue (the SSE layer's feed) and
+    cancel() — the engine half of mid-stream disconnect handling."""
+
+    def test_stream_queue_orders_tokens_then_sentinel(self, tiny_model):
+        model, params = tiny_model
+        eng = _engine(model, params)
+        r = eng.submit([3, 4, 5, 6], 5, top_k=1, stream=True)
+        eng.drain()
+        got = []
+        while True:
+            t = r.stream_q.get(timeout=1)
+            if t is None:
+                break
+            got.append(t)
+        toks, _ = r.result(5)
+        assert got == toks[4:]  # generated tokens, in order
+
+    def test_cancel_queued_fails_waiter_and_closes_stream(
+            self, tiny_model):
+        model, params = tiny_model
+        eng = _engine(model, params)
+        r = eng.submit([3, 4, 5], 4, top_k=1, stream=True)
+        eng.cancel(r)
+        assert r.done.is_set()
+        assert r.stream_q.get(timeout=1) is None
+        with pytest.raises(RuntimeError, match="cancelled"):
+            r.result(1)
+        assert not eng.step()  # nothing left to schedule
+
+    def test_cancel_running_retires_slot_and_reclaims_pages(
+            self, tiny_model):
+        model, params = tiny_model
+        eng = _engine(model, params)
+        r = eng.submit([3, 4, 5, 6], 30, top_k=1, stream=True)
+        while r.t_first == 0:
+            eng.step()
+        eng.cancel(r)
+        eng.step()  # the scheduler reaps it
+        assert r.done.is_set()
+        with pytest.raises(RuntimeError, match="cancelled"):
+            r.result(1)
+        c = eng.counters()
+        assert c["serve_pages_in_use"] == 0
+        assert c["serve_cancelled"] == 1
+        # the stream closed with the sentinel after the booked tokens
+        drained = []
+        while True:
+            t = r.stream_q.get(timeout=1)
+            if t is None:
+                break
+            drained.append(t)
+        assert drained == r.tokens[4:]
+        # cancel is idempotent on finished requests
+        eng.cancel(r)
+        assert eng.counters()["serve_cancelled"] == 1
+
+
 class TestSampling:
     def test_seed_determinism_independent_of_slot(self, tiny_model):
         """The same (prompt, seed) produces the same stream no matter

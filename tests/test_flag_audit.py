@@ -239,6 +239,27 @@ def test_entry_script_flags_are_registered_there():
             )
 
 
+def test_cp_with_padding_mask_models_rejected_at_config():
+    """ADVICE r5 carry-forward (ISSUE 6 satellite): BERT/T5 need dense
+    padding masks, which have no packed-document {'doc_start'} form, so
+    cp>1 used to dead-end MID-FORWARD (models/attention.py raises on
+    the first masked layer). args_to_configs must reject the
+    combination at config construction, with the alternatives; causal
+    families keep cp."""
+    p = build_base_parser()
+    for name in ("bert", "t5"):
+        argv = ["--model_name", name, "--context_parallel_size", "2"]
+        with pytest.raises(SystemExit) as e:
+            args_to_configs(p.parse_args(argv), 50257)
+        msg = str(e.value)
+        assert "padding masks" in msg and name in msg, msg
+        assert "--context_parallel_size 1" in msg  # the way out
+    _, pcfg, _, _ = args_to_configs(
+        p.parse_args(["--model_name", "gpt",
+                      "--context_parallel_size", "2"]), 50257)
+    assert pcfg.context_parallel_size == 2
+
+
 def test_remat_policy_flag_has_effect():
     """--remat_policy (beyond-reference flag) must land in ModelConfig."""
     p = build_base_parser()

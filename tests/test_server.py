@@ -12,7 +12,12 @@ Four layers pinned:
   instead of stacking behind the device lock;
 - queue-full: submit past max_queue -> 503 with Retry-After;
 - the prefill_len bucketing regression: distinct short prompt lengths
-  share one compiled decode executable (ISSUE 3 satellite).
+  share one compiled decode executable (ISSUE 3 satellite);
+- SSE token streaming (ISSUE 6): stream-request validation stays plain
+  JSON; the first token crosses the wire BEFORE generation completes
+  (pinned against a manually-stepped engine, no timing luck); the
+  stream equals the buffered response; a mid-stream client disconnect
+  cancels the request — slot retired, pages reclaimed.
 """
 
 import json
@@ -362,6 +367,251 @@ class TestEndToEnd:
         for status, body, headers in rejected:
             assert body == {"message": QUEUE_FULL_MSG}
             assert headers.get("Retry-After") == "1"
+
+
+# ---------------------------------------------------------------------------
+# SSE token streaming (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_validation_stays_plain_json():
+    """Stream-request failures answer JSON BEFORE any SSE bytes: no
+    engine, streaming disabled, multi-prompt, score-only, beam."""
+    from megatron_llm_tpu.inference.engine import QueueFull
+
+    sentinel = object()
+
+    def no_stream(*a, **k):
+        raise AssertionError("must not start streaming")
+
+    # no engine
+    gen = MegatronGenerate(_NoModel(), None, ByteTokenizer())
+    got, status = gen.put_stream(
+        {"prompts": ["a"], "stream": True}, no_stream, no_stream)
+    assert status == 400 and "engine" in got["message"]
+
+    class StubEngine:
+        max_context = 1024
+        num_pages = 17
+        page_size = 64
+
+        def submit(self, *a, **k):
+            raise QueueFull("full")
+
+    # disabled
+    gen = MegatronGenerate(_NoModel(), None, ByteTokenizer(),
+                           engine=StubEngine(), stream_enabled=False)
+    got, status = gen.put_stream(
+        {"prompts": ["a"], "stream": True}, no_stream, no_stream)
+    assert status == 400 and "disabled" in got["message"]
+
+    gen = MegatronGenerate(_NoModel(), None, ByteTokenizer(),
+                           engine=StubEngine())
+    cases = [
+        ({"prompts": ["a", "b"], "stream": True}, 400, "one prompt"),
+        ({"prompts": ["a"], "tokens_to_generate": 0, "logprobs": True,
+          "stream": True}, 400, "tokens_to_generate"),
+        ({"prompts": ["a"], "beam_width": 1, "stream": True}, 400,
+         "beam"),
+        # logprobs are rejected loudly, not silently dropped (the
+        # buffered engine path returns them; a stream that quietly
+        # omitted them would lie)
+        ({"prompts": ["a"], "logprobs": True, "stream": True}, 400,
+         "logprobs"),
+        # knob validation rides the shared surface, byte-parity intact
+        ({"prompts": ["a"], "temperature": 0.0, "stream": True}, 400,
+         sentinel),
+        # a full queue is still 503 + queue-full message
+        ({"prompts": ["a"], "stream": True}, 503, QUEUE_FULL_MSG),
+    ]
+    for payload, want_status, frag in cases:
+        got, status = gen.put_stream(payload, no_stream, no_stream)
+        assert status == want_status, (payload, got)
+        if frag is sentinel:
+            assert got == ("temperature must be a positive number less "
+                           "than or equal to 100.0")
+        else:
+            assert frag in got["message"], (payload, got)
+
+
+@pytest.fixture()
+def stepped_server():
+    """A served engine whose scheduler does NOT run in the background:
+    the test drives `engine.step()` by hand, so 'the first token
+    arrived while generation was incomplete' is a construction, not a
+    race."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.config import tiny_config
+    from megatron_llm_tpu.inference.engine import DecodeEngine
+    from megatron_llm_tpu.models import LlamaModel
+
+    cfg = tiny_config(compute_dtype=jnp.float32, use_decode_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(7))
+    tok = ByteTokenizer()
+    engine = DecodeEngine(model, params, slots=2, page_size=16,
+                          max_context=64, max_queue=8,
+                          termination_id=tok.eod,
+                          vocab_size=tok.vocab_size, prefix_cache=True)
+    engine.start = lambda: None  # the test is the scheduler
+    srv = MegatronServer(model, params, tok, engine=engine)
+    srv.run("127.0.0.1", 0, block=False)
+    port = srv._httpd.server_address[1]
+    yield engine, port, tok, srv, params
+    srv._httpd.shutdown()
+
+
+def _read_events(resp, n=None):
+    """Read SSE `data:` events incrementally off the raw response; stop
+    after n events (or EOF)."""
+    events = []
+    while n is None or len(events) < n:
+        line = resp.fp.readline()
+        if not line:
+            break
+        line = line.decode().strip()
+        if line.startswith("data: "):
+            events.append(json.loads(line[6:]))
+    return events
+
+
+@pytest.mark.slow
+class TestStreaming:
+    def test_first_token_streams_before_generation_completes(
+            self, stepped_server):
+        """ISSUE 6 acceptance: with the engine stepped by hand, the
+        first SSE event is read while the slot is still mid-generation
+        — streaming delivers tokens as they are booked, not at the
+        end — and the finished stream equals the buffered engine path
+        bitwise."""
+        engine, port, tok, srv, params = stepped_server
+        payload = {"prompts": ["hello"], "tokens_to_generate": 24,
+                   "top_k": 1, "stream": True}
+        conn = HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("PUT", "/api", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+
+        # admit + produce exactly the first generated token
+        deadline = time.time() + 60
+        while engine._tokens_out == 0:
+            assert time.time() < deadline
+            engine.step()
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        first = _read_events(resp, n=1)[0]
+        # generation is INCOMPLETE by construction: only stepped to the
+        # first booked token
+        busy = engine.health()["slots_busy"]
+        assert busy == 1 and engine._tokens_out < 24
+        assert isinstance(first["token"], int)
+
+        while engine.step():
+            pass
+        rest = _read_events(resp)
+        conn.close()
+        events = [first] + rest
+        assert events[-1]["done"] is True
+        toks = [e["token"] for e in events[:-1]]
+        assert toks == events[-1]["tokens"]
+
+        # equals the buffered engine path for the same prompt
+        req = engine.submit(tok.tokenize("hello"), 24, top_k=1)
+        while engine.step():
+            pass
+        ref_toks, _ = req.result(5)
+        assert toks == ref_toks[len(tok.tokenize("hello")):]
+        assert events[-1]["text"] == tok.detokenize(ref_toks)
+        # per-event text is an INCREMENTAL delta: concatenated, it is a
+        # prefix of the generated text (a trailing undecodable byte
+        # sequence may be held back; the final event is authoritative)
+        joined = "".join(e["text"] for e in events[:-1])
+        assert tok.detokenize(toks).startswith(joined)
+
+    def test_delta_window_flush_keeps_text_exact(self, stepped_server):
+        """The bounded detokenization window (stream_flush_tokens)
+        resets with a one-token overlap: across several flushes the
+        concatenated deltas still reproduce the generated text exactly
+        (ByteTokenizer windows decode positionally, so any flush
+        artifact would surface as lost/duplicated characters)."""
+        engine, port, tok, srv, params = stepped_server
+        srv.generator.stream_flush_tokens = 5  # several flushes in 30
+
+        conn = HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("PUT", "/api", json.dumps(
+            {"prompts": ["abc"], "tokens_to_generate": 30, "top_k": 1,
+             "stream": True}), {"Content-Type": "application/json"})
+        t = threading.Thread(target=lambda: [engine.step() or
+                                             time.sleep(0.002)
+                                             for _ in range(4000)],
+                             daemon=True)
+        t.start()
+        resp = conn.getresponse()
+        events = _read_events(resp)
+        conn.close()
+        assert events[-1]["done"] is True
+        toks = [e["token"] for e in events[:-1]]
+        joined = "".join(e["text"] for e in events[:-1])
+        full = tok.detokenize(toks)
+        # deltas reproduce the generated text up to a held-back
+        # undecodable tail
+        assert full.startswith(joined)
+        assert len(full) - len(joined) <= 4
+
+    def test_midstream_disconnect_retires_slot_reclaims_pages(
+            self, stepped_server):
+        """A client that vanishes mid-stream must not pin the slot: the
+        next write fails, the request cancels, the slot retires, and
+        every page returns/releases (prefix-cache refcounts intact —
+        cached pages stay cached, nothing leaks)."""
+        import socket
+        import struct
+
+        engine, port, tok, srv, params = stepped_server
+        body = json.dumps({"prompts": ["zzzz"], "tokens_to_generate": 40,
+                           "top_k": 1, "stream": True}).encode()
+        s = socket.create_connection(("127.0.0.1", port))
+        s.sendall(b"PUT /api HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Type: application/json\r\n"
+                  + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                  + body)
+        deadline = time.time() + 60
+        buf = b""
+        while b"data: " not in buf:
+            assert time.time() < deadline
+            engine.step()
+            s.setblocking(False)
+            try:
+                buf += s.recv(65536)
+            except BlockingIOError:
+                pass
+            s.setblocking(True)
+        # hard RST: the server's next write fails immediately
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        s.close()
+        while time.time() < deadline:
+            engine.step()
+            c = engine.counters()
+            if (c["serve_cancelled"] >= 1
+                    and engine.health()["slots_busy"] == 0):
+                break
+            time.sleep(0.005)
+        c = engine.counters()
+        assert c["serve_cancelled"] == 1
+        assert engine.health()["slots_busy"] == 0
+        # full page accounting: nothing leaked — pages are either free
+        # or retained by the prefix cache as unreferenced entries
+        assert c["serve_pages_free"] + c["serve_prefix_cached_pages"] \
+            == engine.num_pages - 1
+        assert engine._prefix.referenced_pages == 0
+        # the engine still serves: a fresh buffered request completes
+        req = engine.submit(tok.tokenize("ok"), 4, top_k=1)
+        while engine.step():
+            pass
+        assert len(req.result(5)[0]) == 2 + 4
 
 
 # ---------------------------------------------------------------------------

@@ -61,6 +61,31 @@ def main():
                         "request past it fails with a timeout and its "
                         "slot's KV pages return to the pool (ISSUE 5 "
                         "serving robustness; default: no deadline)")
+    # ISSUE 6 serving features (docs/GUIDE.md "Prefix caching,
+    # streaming, and speculative decoding")
+    p.add_argument("--prefix_cache", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="share prompt-prefix KV pages across requests "
+                        "(refcounted page-aligned cache, COW on mid-page "
+                        "divergence, LRU eviction under pool pressure). "
+                        "Default: on whenever chunked admission is on "
+                        "(--prefill_chunk_tokens > 0 is required); pass "
+                        "--prefix_cache with --prefill_chunk_tokens 0 to "
+                        "get the loud incompatibility error instead of a "
+                        "silent downgrade")
+    p.add_argument("--spec_decode_k", type=int, default=0,
+                   help="speculative decoding: prompt-lookup n-gram "
+                        "drafts of up to K tokens per greedy slot, "
+                        "verified in one width-(K+1) ragged chunk; "
+                        "greedy token streams stay bitwise. 0 disables "
+                        "(the right call for short generations or "
+                        "non-repetitive traffic — see GUIDE)")
+    p.add_argument("--stream", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="serve SSE token streaming for {\"stream\": "
+                        "true} PUTs (one data: event per generated "
+                        "token); --no_stream turns the surface off "
+                        "(e.g. behind a buffering proxy)")
     args = p.parse_args()
 
     import jax
@@ -117,6 +142,11 @@ def main():
     if args.serving_slots > 0:
         from megatron_llm_tpu.inference.engine import DecodeEngine
 
+        # --prefix_cache default (None) is AUTO: on whenever chunked
+        # admission is on. An explicit --prefix_cache with chunking off
+        # reaches the engine ctor's loud incompatibility error.
+        prefix_cache = (args.prefix_cache if args.prefix_cache is not None
+                        else args.prefill_chunk_tokens > 0)
         engine = DecodeEngine(
             model, params, slots=args.serving_slots,
             page_size=args.page_size, max_context=args.max_context,
@@ -124,6 +154,8 @@ def main():
             step_horizon=args.step_horizon,
             prefill_chunk_tokens=args.prefill_chunk_tokens,
             warmup_compile=args.warmup_compile,
+            prefix_cache=prefix_cache,
+            spec_decode_k=args.spec_decode_k,
             termination_id=tokenizer.eod,
             vocab_size=tokenizer.vocab_size,
         )
@@ -134,10 +166,15 @@ def main():
              + (f"chunked prefill {engine.prefill_chunk_tokens} tok/round"
                 if engine.prefill_chunk_tokens else
                 "whole-prompt prefill")
+             + (", prefix cache" if engine._prefix is not None else "")
+             + (f", spec decode k={engine.spec_decode_k}"
+                if engine.spec_decode_k else "")
+             + (", SSE streaming" if args.stream else "")
              + ", counters at /metrics, health at /health)"
              if engine else " (whole-batch, no engine)"), flush=True)
     MegatronServer(model, params, tokenizer, engine=engine,
-                   request_deadline_s=args.request_deadline_s).run(
+                   request_deadline_s=args.request_deadline_s,
+                   stream_enabled=args.stream).run(
         args.host, args.port)
 
 
