@@ -1,0 +1,39 @@
+"""Static analysis for JAX trace discipline (ISSUE 7).
+
+Two passes, one gate:
+
+- `lint` (analysis/lint.py): an AST linter with JAX-specific rules —
+  tracer host-syncs inside jitted code, jit construction in loops,
+  unhashable statics, host entropy in traced code, order-unstable
+  pytree construction, host syncs in the engine/trainer hot loops,
+  and bare `jax.jit` entry points that bypass the contract registry.
+  Accepted findings live in `lint_baseline.json` with per-finding
+  justifications.
+- `contracts` + `audit` (analysis/contracts.py, analysis/audit.py):
+  every jitted entry point registers a `@compile_contract` declaring
+  its variant budget (how many executables traffic may mint), its
+  collective inventory per mesh shape, and its compiled temp-memory
+  budget; the auditor AOT-lowers each on a CPU mesh and checks the
+  lowered artifact against the declaration — the pjit-on-TPUv4 /
+  EQuARX discipline of auditing the compiled collective inventory
+  rather than inferring it.
+
+`tools/graft_check.py` is the CLI gate over both passes.
+
+This package must stay importable WITHOUT jax: the contract registry
+is bookkeeping (inference/engine.py imports it on every engine), and
+the linter is pure `ast`. Only analysis/audit.py touches jax, lazily.
+"""
+
+from megatron_llm_tpu.analysis.contracts import (  # noqa: F401
+    CompileContract,
+    ContractViolation,
+    compile_contract,
+    get_contract,
+    record_variant,
+    release_variant,
+    register_contract,
+    total_live_variants,
+    variant_count,
+    variants,
+)

@@ -1,0 +1,447 @@
+"""graft-check pass 2: the AOT compile-contract audit.
+
+Every `@compile_contract` declaration (analysis/contracts.py) is a
+claim about the COMPILED artifact: how many executables traffic may
+mint, which collectives the lowered HLO may contain per mesh shape,
+that no host callbacks or fp64 ops appear, and how much compiled temp
+memory the entry point may use at the audit reference config. This
+module checks those claims the way the pjit-on-TPUv4 and EQuARX papers
+treat collective inventories — by lowering and reading the artifact,
+not by inferring from source.
+
+Reference configs are TINY (2-layer models, 8-token contexts) and the
+meshes are virtual CPU devices, so the whole audit runs in seconds
+under `JAX_PLATFORMS=cpu` anywhere. Tiny shapes still pin the
+INVENTORY (which collectives, which callbacks, f64 or not) exactly,
+and the temp-bytes budgets pin relative regressions: a remat/layout
+change that blows up compiled temp memory is visible here long before
+a production shape exists.
+
+Entry points audited (the registry's lowerable surface):
+- the five engine builders, through `DecodeEngine.audit_entry_points()`
+  against the engine's REAL pools (mesh tag "single");
+- `train.step` on tp2 AND dp2x2 meshes — the two forecast mesh shapes
+  whose collective inventories ROADMAP items 1/2/4 will be verified
+  against;
+- `generate.tokens`, `realm.chunk_topk`, `ops.flash_attention` on a
+  single device.
+
+`api.pp_decode` / `api.pp_score` / `train.pipeline_step` /
+`train.eval_step` carry variant-counted contracts but declare
+`collectives=None`: their lowering needs a pp mesh plus a stage-sharded
+model and is exercised by the pp test suites; the audit still checks
+their budget declarations and marker consistency.
+
+jax is imported lazily — importing this module costs nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from megatron_llm_tpu.analysis.contracts import (
+    COLLECTIVE_OPS,
+    all_contracts,
+    get_contract,
+    total_live_variants,
+)
+
+__all__ = [
+    "TargetResult",
+    "audit_lowered",
+    "audit_repo",
+    "check_contract_markers",
+    "collectives_in_text",
+]
+
+KNOWN_FAILURES_DOC = "KNOWN_FAILURES.md"
+
+# mesh tag -> (dp, tp). "single" is the no-mesh case.
+MESH_TAGS: Dict[str, Tuple[int, int]] = {
+    "single": (1, 1),
+    "tp2": (1, 2),
+    "dp2tp2": (2, 2),
+}
+
+_COLLECTIVE_RE = re.compile(
+    r"\b(" + "|".join(re.escape(c) for c in COLLECTIVE_OPS) + r")\b")
+_CUSTOM_CALL_RE = re.compile(r'custom_call_target="([^"]+)"')
+_F64_RE = re.compile(r"\bf64\[")
+_CALLBACK_MARKERS = ("callback", "infeed", "outfeed", "host")
+
+
+@dataclass
+class TargetResult:
+    """One (contract, mesh tag) audit verdict."""
+
+    contract: str
+    mesh_tag: str
+    ok: bool = True
+    failures: List[str] = field(default_factory=list)
+    facts: Dict[str, Any] = field(default_factory=dict)
+
+    def fail(self, msg: str):
+        self.ok = False
+        self.failures.append(msg)
+
+    def to_dict(self) -> dict:
+        return {
+            "contract": self.contract, "mesh": self.mesh_tag,
+            "ok": self.ok, "failures": self.failures, "facts": self.facts,
+        }
+
+
+def collectives_in_text(hlo_text: str) -> frozenset:
+    return frozenset(_COLLECTIVE_RE.findall(hlo_text))
+
+
+def _host_callback_targets(hlo_text: str) -> List[str]:
+    out = []
+    for tgt in set(_CUSTOM_CALL_RE.findall(hlo_text)):
+        low = tgt.lower()
+        if any(m in low for m in _CALLBACK_MARKERS):
+            out.append(tgt)
+    for op in ("infeed", "outfeed"):
+        if re.search(rf"\b{op}\b", hlo_text):
+            out.append(op)
+    return sorted(out)
+
+
+def audit_lowered(name: str, mesh_tag: str, fn, args: tuple,
+                  kwargs: Optional[dict] = None) -> TargetResult:
+    """Lower+compile one registered entry point and check the compiled
+    artifact against its contract: collective inventory for this mesh
+    tag, host callbacks, fp64, and the temp-bytes budget."""
+    contract = get_contract(name)
+    res = TargetResult(contract=name, mesh_tag=mesh_tag)
+    compiled = fn.lower(*args, **(kwargs or {})).compile()
+    text = compiled.as_text()
+
+    found = collectives_in_text(text)
+    res.facts["collectives"] = sorted(found)
+    if contract.collectives is not None:
+        if mesh_tag not in contract.collectives:
+            res.fail(
+                f"mesh tag {mesh_tag!r} not declared in the contract's "
+                f"collective inventory (declared: "
+                f"{sorted(contract.collectives)}) — declare the allowed "
+                f"set for this mesh shape")
+        else:
+            declared = frozenset(contract.collectives[mesh_tag])
+            if found != declared:
+                res.fail(
+                    f"collective inventory mismatch on {mesh_tag}: "
+                    f"lowered HLO contains {sorted(found)}, contract "
+                    f"declares {sorted(declared)} — an undeclared "
+                    f"collective is exactly the regression benchmarks "
+                    f"catch late; update the declaration only WITH the "
+                    f"change that justifies it")
+
+    callbacks = _host_callback_targets(text)
+    res.facts["host_callbacks"] = callbacks
+    if callbacks and not contract.allow_host_callbacks:
+        res.fail(
+            f"host callbacks in lowered HLO: {callbacks} — a device-host "
+            f"round trip inside a jitted entry point (allow_host_callbacks"
+            f"=True only with justification)")
+
+    has_f64 = bool(_F64_RE.search(text))
+    res.facts["f64"] = has_f64
+    if has_f64 and not contract.allow_f64:
+        res.fail(
+            "fp64 ops in lowered HLO: TPUs emulate f64 at a massive "
+            "slowdown — an accidental float64 promotion (Python float "
+            "into jnp.asarray, np default dtypes) is leaking into the "
+            "traced graph")
+
+    try:
+        mem = compiled.memory_analysis()
+        tmp = int(mem.temp_size_in_bytes)
+        res.facts["temp_bytes"] = tmp
+        if contract.tmp_bytes_budget is not None \
+                and tmp > contract.tmp_bytes_budget:
+            res.fail(
+                f"compiled temp memory {tmp} bytes exceeds the declared "
+                f"budget {contract.tmp_bytes_budget} at the audit "
+                f"reference config — a layout/remat/fusion regression, "
+                f"or a budget that must be re-justified")
+    except Exception as e:  # platform without memory_analysis
+        res.facts["temp_bytes"] = f"unavailable: {e}"
+
+    # summed across ALL owner buckets: the engine contracts' variants
+    # live under owner=engine, and a per-owner read here (no owner in
+    # scope) would publish a misleading constant 0 in the report
+    res.facts["live_variants"] = total_live_variants(name)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Reference targets
+# ---------------------------------------------------------------------------
+
+
+def _tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.config import tiny_config
+    from megatron_llm_tpu.models import LlamaModel
+
+    cfg = tiny_config(compute_dtype=jnp.float32, use_decode_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+def _audit_engine() -> List[TargetResult]:
+    """The five engine entry points, lowered against a real (tiny)
+    engine with chunked prefill AND speculative decoding configured so
+    every builder is reachable. Also checks the config-derived bucket
+    budgets stay within each contract's declared max_variants — the
+    same helpers (horizon_buckets / mixed_width_buckets) the engine
+    passes at mint time, so the audit and the runtime cannot drift."""
+    from megatron_llm_tpu.inference.engine import (
+        DecodeEngine,
+        horizon_buckets,
+        mixed_width_buckets,
+    )
+
+    model, params = _tiny_model()
+    eng = DecodeEngine(
+        model, params, slots=2, page_size=16, max_context=64,
+        step_horizon=8, prefill_chunk_tokens=16, spec_decode_k=2,
+        vocab_size=256)
+
+    results = []
+    for name, fn, args in eng.audit_entry_points():
+        results.append(audit_lowered(name, "single", fn, args))
+
+    budgets = {
+        "engine.decode_scan": 2 * len(horizon_buckets(eng.step_horizon)),
+        "engine.mixed_step":
+            2 * len(mixed_width_buckets(eng.prefill_chunk_tokens)),
+        "engine.prefill_bucket": eng._PREFILL_CACHE_CAP,
+        "engine.spec_verify": 2,
+        "engine.page_copy": 1,
+    }
+    for res in results:
+        contract = get_contract(res.contract)
+        derived = budgets[res.contract]
+        res.facts["config_budget"] = derived
+        res.facts["max_variants"] = contract.max_variants
+        if contract.max_variants is not None \
+                and derived > contract.max_variants:
+            res.fail(
+                f"config-derived budget {derived} exceeds declared "
+                f"max_variants {contract.max_variants}: the pow2 bucket "
+                f"math and the contract declaration disagree")
+    return results
+
+
+def _audit_train_step(mesh_tag: str) -> TargetResult:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from megatron_llm_tpu.config import (
+        ParallelConfig,
+        TrainConfig,
+        tiny_config,
+    )
+    from megatron_llm_tpu.models import LlamaModel
+    from megatron_llm_tpu.optimizer.optimizer import init_optimizer_state
+    from megatron_llm_tpu.parallel.mesh import (
+        destroy_parallel,
+        initialize_parallel,
+    )
+    from megatron_llm_tpu.parallel.sharding import param_specs
+    from megatron_llm_tpu.training.train_step import make_train_step
+
+    dp, tp = MESH_TAGS[mesh_tag]
+    cfg = tiny_config(
+        num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=4, ffn_hidden_size=128, seq_length=32,
+        max_position_embeddings=32, padded_vocab_size=128,
+        params_dtype=jnp.float32, compute_dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    ctx = initialize_parallel(dp=dp, pp=1, tp=tp)
+    try:
+        mesh = ctx.mesh
+        tmpl = jax.eval_shape(model.init, jax.random.key(0))
+        pspecs = param_specs(cfg, tmpl)
+        psh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                           is_leaf=lambda x: isinstance(x, P))
+        params = jax.jit(model.init, out_shardings=psh)(jax.random.key(0))
+        tcfg = TrainConfig(micro_batch_size=2, global_batch_size=2 * dp,
+                           lr=1e-4)
+        opt_state = init_optimizer_state(params, tcfg)
+        pcfg = ParallelConfig(num_microbatches=1, data_parallel_size=dp,
+                              tensor_parallel_size=tp)
+        # graft-contract: train.step
+        step = jax.jit(
+            make_train_step(model, tcfg, pcfg, contract_key=("audit", 1),
+                            contract_owner=None),
+            donate_argnums=(0, 1))
+        tokens = jnp.asarray(
+            np.zeros((1, 2 * dp, cfg.seq_length), np.int32))
+        tokens = jax.device_put(
+            tokens, NamedSharding(mesh, P(None, "data", None)))
+        batch = {"tokens": tokens, "labels": tokens}
+        # the PRODUCTION specialization: the trainer always passes a
+        # traced fp32 spike threshold (loss-watchdog in-step skip gate,
+        # trainer.py "ONE trace either way"), so the audited HLO must
+        # contain the found_inf machinery traffic actually runs. rng
+        # stays None — the no-dropout config's own specialization.
+        return audit_lowered(
+            "train.step", mesh_tag, step,
+            (params, opt_state, batch, jnp.float32(1e-4),
+             jnp.float32(0.0), None, jnp.float32(np.inf)))
+    finally:
+        destroy_parallel()
+
+
+def _audit_generate_tokens() -> TargetResult:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from megatron_llm_tpu.inference.generation import generate_tokens
+
+    model, params = _tiny_model()
+    tokens = jnp.asarray(np.zeros((1, 16), np.int32))
+    lengths = jnp.asarray(np.asarray([8], np.int32))
+    return audit_lowered(
+        "generate.tokens", "single", generate_tokens,
+        (model, params, tokens, lengths, 8),
+        {"top_k": 1, "vocab_size": 256})
+
+
+def _audit_chunk_topk() -> TargetResult:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from megatron_llm_tpu.data.realm_index import _chunk_topk
+
+    fn = _chunk_topk()
+    q = jnp.asarray(np.zeros((4, 8), np.float32))
+    ev = jnp.asarray(np.zeros((16, 8), np.float32))
+    return audit_lowered(
+        "realm.chunk_topk", "single", fn,
+        (q, ev, jnp.asarray(16, jnp.int32)), {"k": 2})
+
+
+def _audit_flash_attention() -> TargetResult:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from megatron_llm_tpu.ops.flash_attention import flash_attention
+
+    # the dense XLA path: the Pallas kernel is TPU-gated and its CPU
+    # interpret mode IS a host callback by construction. Layouts: q
+    # (b, s, g, qpk, d), k/v (b, t, g, d) — the grouped GQA layout.
+    q = jnp.asarray(np.zeros((1, 32, 2, 2, 16), np.float32))
+    kv = jnp.asarray(np.zeros((1, 32, 2, 16), np.float32))
+    return audit_lowered(
+        "ops.flash_attention", "single", flash_attention,
+        (q, kv, kv), {"causal": True, "use_pallas": False})
+
+
+def check_contract_markers(root: str) -> List[str]:
+    """Every `# graft-contract: <name>` marker in the package must name
+    a REGISTERED contract — a marker that quiets the GR007 lint while
+    pointing at nothing would make the registry a fiction. Returns a
+    list of problems (empty = consistent). Any package module that
+    DECLARES contracts is imported first, so the registered set does not
+    depend on which audit targets happened to be constructed (a contract
+    like train.pipeline_step registers in a module no CPU target
+    lowers)."""
+    import importlib
+
+    from megatron_llm_tpu.analysis.lint import _CONTRACT_MARK
+
+    problems = []
+    pkg = os.path.join(root, "megatron_llm_tpu")
+    marked: List[tuple] = []  # (path, lineno, line)
+    declaring: List[str] = []  # dotted module names to import
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "analysis")]
+        for f in sorted(filenames):
+            if not f.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, f)
+            declares = False
+            with open(p, "r", encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    if "compile_contract(" in line \
+                            or "register_contract(" in line:
+                        declares = True
+                    if _CONTRACT_MARK in line:
+                        marked.append((p, lineno, line))
+            if declares:
+                rel = os.path.relpath(p, root)[:-len(".py")]
+                mod = rel.replace(os.sep, ".")
+                declaring.append(
+                    mod[:-len(".__init__")] if mod.endswith(".__init__")
+                    else mod)
+    for mod in declaring:
+        try:
+            importlib.import_module(mod)
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            problems.append(
+                f"{mod}: declares contracts but failed to import for "
+                f"marker checking: {e!r}")
+    registered = set(all_contracts())
+    for p, lineno, line in marked:
+        name = line.split(_CONTRACT_MARK, 1)[1].strip()
+        name = name.split()[0] if name else ""
+        if name not in registered:
+            rel = os.path.relpath(p, root)
+            problems.append(
+                f"{rel}:{lineno}: marker names unregistered "
+                f"contract {name!r} (registered: "
+                f"{sorted(registered)})")
+    return problems
+
+
+def audit_repo(root: str) -> dict:
+    """Run the full audit: lower every reference target, check marker
+    consistency, and return a JSON-able report. Requires >= 4 devices
+    for the dp2tp2 mesh (tests/tools provision virtual CPU devices)."""
+    import jax
+
+    results: List[TargetResult] = []
+    results.extend(_audit_engine())
+    n_dev = len(jax.devices())
+    for tag in ("tp2", "dp2tp2"):
+        dp, tp = MESH_TAGS[tag]
+        if dp * tp > n_dev:
+            r = TargetResult(contract="train.step", mesh_tag=tag)
+            r.fail(f"needs {dp * tp} devices, have {n_dev} — provision "
+                   f"virtual CPU devices (utils/virtual_mesh.py)")
+            results.append(r)
+            continue
+        results.append(_audit_train_step(tag))
+    results.append(_audit_generate_tokens())
+    results.append(_audit_chunk_topk())
+    results.append(_audit_flash_attention())
+
+    marker_problems = check_contract_markers(root)
+    audited = {r.contract for r in results}
+    report = {
+        "ok": all(r.ok for r in results) and not marker_problems,
+        "targets": [r.to_dict() for r in results],
+        "entry_points_audited": sorted(audited),
+        "mesh_tags": sorted({r.mesh_tag for r in results}),
+        "marker_problems": marker_problems,
+        "contracts_registered": sorted(all_contracts()),
+        "known_failures": KNOWN_FAILURES_DOC,
+        "note": (
+            "temp-bytes budgets and collective inventories are pinned at "
+            "the tiny audit reference configs; pre-existing slow-suite "
+            f"failures are triaged in {KNOWN_FAILURES_DOC}"),
+    }
+    return report

@@ -1,0 +1,309 @@
+"""Compile-contract registry: declared budgets for every jitted entry point.
+
+The repo's correctness story leaned on scattered per-test executable
+counters (realm_index single-executable, engine horizon buckets,
+spec-decode width-k) and per-PR "one trace per pow2 bucket" claims that
+nothing enforced globally. This module is the ONE counting mechanism:
+
+- `@compile_contract(name, max_variants=..., collectives=...,
+  tmp_bytes_budget=...)` decorates a jitted-entry-point BUILDER
+  (e.g. engine._make_step_fn). Each builder invocation records a
+  VARIANT — one (builder, static-key) executable — under the owner that
+  minted it (an engine instance, a trainer, or the module-global cache).
+- Recording past the declared budget raises `ContractViolation` AT MINT
+  TIME: a retrace storm fails loudly where it starts, not as a latency
+  mystery three layers up. Call sites that know a tighter config-derived
+  budget (the engine's pow2 bucket math) pass `contract_budget=`.
+- Caches that EVICT executables (the LRU prefill/pp-decode caches)
+  call `release_variant` so the live count tracks cache occupancy.
+- `analysis/audit.py` AOT-lowers each registered entry point on a CPU
+  mesh and checks the rest of the declaration (collective inventory per
+  mesh shape, no host callbacks, no fp64, temp-memory budget) against
+  the compiled artifact.
+
+Import-light by design: no jax at module scope — every DecodeEngine
+constructor and test imports this.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, FrozenSet, Mapping, Optional
+
+__all__ = [
+    "CompileContract",
+    "ContractViolation",
+    "compile_contract",
+    "get_contract",
+    "all_contracts",
+    "record_variant",
+    "release_variant",
+    "register_contract",
+    "variants",
+    "variant_count",
+    "total_live_variants",
+    "get_builder",
+    "jit_cache_size",
+]
+
+
+class ContractViolation(AssertionError):
+    """A jitted entry point broke its declared compile contract (variant
+    budget exceeded at mint time, or an audit check failed). Deliberately
+    an AssertionError: test suites that pin executable counts fail the
+    same way they always did, through the one shared counter."""
+
+
+# Collective-inventory keys are the optimized-HLO opcode family names
+# the auditor greps for (analysis/audit.py); a contract declares, per
+# mesh-shape tag, EXACTLY the set allowed to appear.
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+    "collective-broadcast",
+)
+
+
+@dataclass(frozen=True)
+class CompileContract:
+    """The declaration one jitted entry point audits against.
+
+    - `max_variants`: absolute ceiling on live executables this entry
+      point may hold per owner (None = uncounted). Call sites may pass a
+      TIGHTER config-derived budget at record time; this value is the
+      registry-wide backstop and what the audit's bucket math checks.
+    - `collectives`: mesh-shape tag -> frozenset of collective opcodes
+      allowed in the optimized HLO ("single" tags the no-mesh case,
+      where the set is empty). None = not audited for collectives.
+    - `tmp_bytes_budget`: compiled temp_size_in_bytes ceiling for the
+      audit reference config (tiny model on the CPU mesh — the budget
+      pins RELATIVE regressions: a remat/layout change that blows it up
+      is visible long before a production shape exists).
+    - `allow_host_callbacks` / `allow_f64`: both audited to "absent"
+      unless explicitly allowed.
+    """
+
+    name: str
+    max_variants: Optional[int] = None
+    collectives: Optional[Mapping[str, FrozenSet[str]]] = None
+    tmp_bytes_budget: Optional[int] = None
+    allow_host_callbacks: bool = False
+    allow_f64: bool = False
+    notes: str = ""
+
+    def __post_init__(self):
+        if self.collectives is not None:
+            for tag, ops in self.collectives.items():
+                bad = set(ops) - set(COLLECTIVE_OPS)
+                if bad:
+                    raise ValueError(
+                        f"contract {self.name!r}: unknown collective "
+                        f"opcodes {sorted(bad)} for mesh {tag!r} "
+                        f"(known: {COLLECTIVE_OPS})")
+
+
+_LOCK = threading.RLock()
+_REGISTRY: Dict[str, CompileContract] = {}
+_BUILDERS: Dict[str, Callable] = {}
+
+# Variant store: name -> owner-token -> {key: None} (an insertion-ordered
+# set; dict for O(1) discard). Owner tokens are id(owner) with a weakref
+# finalizer so a garbage-collected engine's bucket — and its recycled
+# id() — can never pollute a later owner's count. None owner = the
+# module-global bucket (module-scope executable caches).
+_GLOBAL = "<global>"
+_VARIANTS: Dict[str, Dict[Any, Dict[Any, None]]] = {}
+
+
+def register_contract(contract: CompileContract,
+                      builder: Optional[Callable] = None) -> CompileContract:
+    """Install (or replace — module reloads in tests) a contract."""
+    with _LOCK:
+        _REGISTRY[contract.name] = contract
+        if builder is not None:
+            _BUILDERS[contract.name] = builder
+        _VARIANTS.setdefault(contract.name, {})
+    return contract
+
+
+def get_contract(name: str) -> CompileContract:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"no compile contract registered under {name!r} "
+            f"(registered: {sorted(_REGISTRY)})") from None
+
+
+def all_contracts() -> Dict[str, CompileContract]:
+    with _LOCK:
+        return dict(_REGISTRY)
+
+
+def get_builder(name: str) -> Callable:
+    """The undecorated builder a contract was registered from (the
+    audit constructs entry points through this)."""
+    get_contract(name)
+    try:
+        return _BUILDERS[name]
+    except KeyError:
+        raise KeyError(
+            f"contract {name!r} has no builder (registered via "
+            f"register_contract without one — audit it through an "
+            f"explicit target spec instead)") from None
+
+
+def _owner_token(owner: Any):
+    return _GLOBAL if owner is None else id(owner)
+
+
+def _drop_owner(name: str, token) -> None:
+    with _LOCK:
+        _VARIANTS.get(name, {}).pop(token, None)
+
+
+def record_variant(name: str, key: Any, owner: Any = None,
+                   budget: Optional[int] = None) -> bool:
+    """Count one minted executable for `name` under `owner`. Returns
+    True when the key is new. Raises ContractViolation when the live
+    count would exceed min(budget, contract.max_variants)."""
+    contract = get_contract(name)
+    limits = [b for b in (budget, contract.max_variants) if b is not None]
+    limit = min(limits) if limits else None
+    with _LOCK:
+        token = _owner_token(owner)
+        per_name = _VARIANTS.setdefault(name, {})
+        bucket = per_name.get(token)
+        if bucket is None:
+            bucket = per_name[token] = {}
+            if owner is not None:
+                try:
+                    # drop the bucket when the owner dies: id() values
+                    # are recycled, and a stale bucket under a recycled
+                    # id would hand a brand-new engine another engine's
+                    # variant count. ONE finalizer per bucket — not per
+                    # registry call — or a long-lived engine's LRU churn
+                    # would pile up duplicate finalizers for its lifetime
+                    weakref.finalize(owner, _drop_owner, name, token)
+                except TypeError:
+                    pass  # un-weakrefable owners keep their bucket
+        if key in bucket:
+            return False
+        if limit is not None and len(bucket) + 1 > limit:
+            raise ContractViolation(
+                f"compile contract {name!r}: minting variant {key!r} "
+                f"would exceed the declared budget of {limit} "
+                f"executables (live: {sorted(map(repr, bucket))}). "
+                f"Either the bucketing that bounds this entry point "
+                f"regressed (a retrace storm), or the budget declaration "
+                f"must be updated WITH justification "
+                f"(docs/GUIDE.md, 'Static analysis & compile contracts')")
+        bucket[key] = None
+        return True
+
+
+def release_variant(name: str, key: Any, owner: Any = None) -> bool:
+    """Un-count an EVICTED executable (LRU caches): the budget bounds
+    live executables, which is what the eviction exists to do."""
+    get_contract(name)
+    with _LOCK:
+        bucket = _VARIANTS.get(name, {}).get(_owner_token(owner))
+        if bucket is None or key not in bucket:
+            return False
+        del bucket[key]
+        return True
+
+
+def variants(name: str, owner: Any = None) -> FrozenSet:
+    """The live variant-key set for (entry point, owner) — the ONE
+    counting mechanism the per-suite executable guards read."""
+    get_contract(name)
+    with _LOCK:
+        bucket = _VARIANTS.get(name, {}).get(_owner_token(owner), {})
+        return frozenset(bucket)
+
+
+def variant_count(name: str, owner: Any = None) -> int:
+    return len(variants(name, owner))
+
+
+def total_live_variants(name: str) -> int:
+    """Live executables for `name` summed across ALL owner buckets —
+    what the audit report publishes (per-owner counts would read 0 for
+    engine-scoped contracts when the reader holds no engine)."""
+    get_contract(name)
+    with _LOCK:
+        return sum(len(b) for b in _VARIANTS.get(name, {}).values())
+
+
+def jit_cache_size(fn) -> int:
+    """Live executables in a jitted fn's own call cache. Builder-minted
+    entry points count variants through record_variant; MODULE-LEVEL
+    jits (generate_tokens, realm.chunk_topk) are traced per static/shape
+    key by jax itself, so their executable count lives in the jit call
+    cache — this accessor is the ONE place that touches jax's private
+    `_cache_size`, and what the per-suite single-executable guards call
+    (tests keep their old assertions as thin wrappers over it)."""
+    return int(fn._cache_size())
+
+
+def _auto_key(args, kwargs):
+    """Fallback variant key when a call site passes none: the hashable
+    primitive args (the statics — ints/bools/strs — that split jit
+    executables), in position order. Model objects / configs are
+    deliberately excluded: they select the OWNER, not the variant."""
+    prim = (int, bool, float, str, bytes, type(None), tuple, frozenset)
+    key = [a for a in args if isinstance(a, prim)]
+    key += [v for _, v in sorted(kwargs.items()) if isinstance(v, prim)]
+    return tuple(key)
+
+
+def compile_contract(name: str, *, max_variants: Optional[int] = None,
+                     collectives: Optional[Mapping[str, FrozenSet[str]]]
+                     = None,
+                     tmp_bytes_budget: Optional[int] = None,
+                     allow_host_callbacks: bool = False,
+                     allow_f64: bool = False, notes: str = ""):
+    """Decorator for a jitted-entry-point BUILDER: registers the
+    contract and makes every builder invocation record a variant.
+
+    The wrapped builder accepts three extra keyword-only knobs, all
+    popped before the real builder runs:
+    - `contract_key`: the variant identity (defaults to the hashable
+      primitive args — the jit statics);
+    - `contract_owner`: whose budget the mint counts against (an engine
+      instance, a trainer; None = module-global);
+    - `contract_budget`: a config-derived budget tighter than the
+      declared `max_variants` (the engine's pow2 bucket math).
+    """
+
+    def deco(builder):
+        contract = CompileContract(
+            name=name, max_variants=max_variants, collectives=collectives,
+            tmp_bytes_budget=tmp_bytes_budget,
+            allow_host_callbacks=allow_host_callbacks, allow_f64=allow_f64,
+            notes=notes)
+        register_contract(contract, builder)
+
+        @functools.wraps(builder)
+        def wrapped(*args, contract_key=None, contract_owner=None,
+                    contract_budget=None, **kwargs):
+            fn = builder(*args, **kwargs)
+            record_variant(
+                name,
+                contract_key if contract_key is not None
+                else _auto_key(args, kwargs),
+                owner=contract_owner, budget=contract_budget)
+            return fn
+
+        wrapped.contract = contract
+        wrapped.__contract_builder__ = builder
+        return wrapped
+
+    return deco

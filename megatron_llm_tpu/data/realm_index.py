@@ -24,6 +24,21 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from megatron_llm_tpu.analysis.contracts import (
+    CompileContract,
+    register_contract,
+)
+
+register_contract(CompileContract(
+    name="realm.chunk_topk",
+    max_variants=4,  # one per distinct ((Q, d), (chunk, d), k) a
+    # process searches with; the single-executable test guard reads the
+    # jit cache through contracts.jit_cache_size
+    collectives={"single": frozenset()},
+    tmp_bytes_budget=1 << 20,
+    notes="module-scope chunk scorer; the padded tail keeps partial "
+          "chunks on the same executable (test_msdp_orqa)"))
+
 
 @functools.lru_cache(maxsize=1)
 def _chunk_topk():
@@ -39,6 +54,7 @@ def _chunk_topk():
     import jax
     import jax.numpy as jnp
 
+    # graft-contract: realm.chunk_topk
     @functools.partial(jax.jit, static_argnames=("k",))
     def chunk_topk(q, ev, n_valid, k):
         s = q @ ev.T

@@ -17,12 +17,34 @@ import numpy as np
 
 _logger = logging.getLogger(__name__)
 
+from megatron_llm_tpu.analysis.contracts import (
+    CompileContract,
+    record_variant,
+    register_contract,
+    release_variant,
+)
 from megatron_llm_tpu.inference.generation import (
     beam_search,
     bucket_prefill_len,
     generate_tokens,
     score_tokens,
 )
+
+register_contract(CompileContract(
+    name="api.pp_decode",
+    max_variants=8,  # == the LRU cap below; eviction releases, so the
+    # live variant count IS the executable cache occupancy
+    collectives=None,  # lowering needs a pp mesh + stage-sharded model;
+    # test_pp_inference exercises the ring — variants/markers audited
+    notes="pp>1 pipelined decode, LRU-bounded per (model, mesh, "
+          "statics); every eviction warns (recompile footgun)"))
+register_contract(CompileContract(
+    name="api.pp_score",
+    max_variants=4,  # == the LRU cap below; eviction releases, so the
+    # live variant count IS the executable cache occupancy
+    collectives=None,
+    notes="pp>1 pipelined scorer, LRU-bounded per (model, mesh); "
+          "keyed on the model OBJECT"))
 from megatron_llm_tpu.inference.tokenization import (
     detokenize_generations,
     tokenize_prompts,
@@ -85,6 +107,8 @@ def _pp_decode_fn(model, ctx, statics):
     while len(_PP_DECODE_CACHE) >= 8:
         old_key = next(iter(_PP_DECODE_CACHE))
         _PP_DECODE_CACHE.pop(old_key)
+        # the contract budget counts LIVE executables: eviction un-counts
+        release_variant("api.pp_decode", old_key)
         _logger.warning(
             "pp decode executable cache full (8): evicting LRU entry "
             "with statics %s; the next request at that shape recompiles "
@@ -101,7 +125,8 @@ def _pp_decode_fn(model, ctx, statics):
     pcfg = ParallelConfig(pipeline_parallel_size=ctx.pp,
                           tensor_parallel_size=ctx.tp,
                           context_parallel_size=ctx.cp)
-    _PP_DECODE_CACHE[key] = jax.jit(make_pipelined_decode_fn(
+    # graft-contract: api.pp_decode
+    fn = jax.jit(make_pipelined_decode_fn(
         model, pcfg, ctx, prefill_len=prefill_len, max_len=max_len,
         greedy=greedy, top_k=top_k, top_p=top_p,
         temperature=temperature, vocab_size=vocab_size,
@@ -109,24 +134,48 @@ def _pp_decode_fn(model, ctx, statics):
         use_eod_for_early_termination=use_eod_early,
         return_log_probs=return_log_probs,
     ))
-    return _PP_DECODE_CACHE[key]
+    # record AFTER the build: a builder exception must never leave a
+    # phantom live variant the LRU eviction (which only releases keys it
+    # pops from the cache) could never un-count
+    record_variant("api.pp_decode", key)
+    _PP_DECODE_CACHE[key] = fn
+    return fn
 
 
 def _pp_score_fn(model, ctx):
     key = (model, ctx.mesh)
-    if key not in _PP_SCORE_CACHE:
-        from megatron_llm_tpu.config import ParallelConfig
-        from megatron_llm_tpu.parallel.pipeline import (
-            make_pipelined_score_fn,
+    if key in _PP_SCORE_CACHE:
+        # LRU requeue, same policy as _pp_decode_fn
+        fn = _PP_SCORE_CACHE.pop(key)
+        _PP_SCORE_CACHE[key] = fn
+        return fn
+    # bound the cache at the contract budget: (model, mesh) keys are
+    # unbounded across checkpoint reloads that build fresh model
+    # objects — without eviction the 5th distinct model would turn
+    # cache growth into an unrecoverable ContractViolation
+    while len(_PP_SCORE_CACHE) >= 4:
+        old_key = next(iter(_PP_SCORE_CACHE))
+        _PP_SCORE_CACHE.pop(old_key)
+        release_variant("api.pp_score", old_key)
+        _logger.warning(
+            "pp score executable cache full (4): evicting LRU entry; "
+            "the next score at that (model, mesh) recompiles the "
+            "pipelined scorer",
         )
+    from megatron_llm_tpu.config import ParallelConfig
+    from megatron_llm_tpu.parallel.pipeline import (
+        make_pipelined_score_fn,
+    )
 
-        pcfg = ParallelConfig(pipeline_parallel_size=ctx.pp,
-                              tensor_parallel_size=ctx.tp,
-                              context_parallel_size=ctx.cp)
-        _PP_SCORE_CACHE[key] = jax.jit(
-            make_pipelined_score_fn(model, pcfg, ctx)
-        )
-    return _PP_SCORE_CACHE[key]
+    pcfg = ParallelConfig(pipeline_parallel_size=ctx.pp,
+                          tensor_parallel_size=ctx.tp,
+                          context_parallel_size=ctx.cp)
+    # graft-contract: api.pp_score
+    fn = jax.jit(make_pipelined_score_fn(model, pcfg, ctx))
+    # record AFTER the build, as in _pp_decode_fn
+    record_variant("api.pp_score", key)
+    _PP_SCORE_CACHE[key] = fn
+    return fn
 
 
 def _pp_serving_params(model, ctx, params):

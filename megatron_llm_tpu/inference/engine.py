@@ -97,6 +97,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from megatron_llm_tpu.analysis.contracts import (
+    compile_contract,
+    release_variant,
+)
 from megatron_llm_tpu.inference.generation import bucket_prefill_len
 from megatron_llm_tpu.inference.prefix_cache import PrefixCache
 from megatron_llm_tpu.inference.sampling import (
@@ -105,6 +109,35 @@ from megatron_llm_tpu.inference.sampling import (
 )
 
 _logger = logging.getLogger(__name__)
+
+
+def horizon_buckets(step_horizon: int) -> list:
+    """The pow2 decode-scan horizons an engine with this step_horizon
+    can ever dispatch: {1, 2, 4, ..., pow2floor(step_horizon)}. ONE
+    definition shared by warmup(), the contract budget, and the audit —
+    the claim 'at most log2(H)+1 scan lengths trace' is enforced, not
+    asserted in prose."""
+    top = 1 << (max(step_horizon, 1).bit_length() - 1)
+    out, h = [], 1
+    while h <= top:
+        out.append(h)
+        h *= 2
+    return out
+
+
+def mixed_width_buckets(prefill_chunk_tokens: int) -> list:
+    """The mixed-step chunk widths _chunk_width can ever return: every
+    pow2 below the budget plus the budget itself — log2(C)+1 buckets.
+    Shared by warmup(), the contract budget, and the audit."""
+    c = prefill_chunk_tokens
+    if c <= 0:
+        return []
+    widths = {c}
+    w = 1
+    while w < c:
+        widths.add(w)
+        w *= 2
+    return sorted(widths)
 
 
 class QueueFull(RuntimeError):
@@ -259,6 +292,14 @@ class _Slot:
             self.req.prompt)
 
 
+@compile_contract(
+    "engine.decode_scan",
+    max_variants=16,  # 2 specializations x (log2(horizon)+1) pow2 buckets
+    collectives={"single": frozenset()},
+    tmp_bytes_budget=1 << 20,
+    notes="pow2-bucketed scan horizons x {greedy, mixed}; the engine "
+          "passes the config-derived budget "
+          "2*len(horizon_buckets(step_horizon)) at mint time")
 def _make_step_fn(model, vocab_size, horizon, all_greedy):
     """The jitted continuous-batching step, traced once per (engine,
     horizon bucket): a lax.scan of `horizon` single-token steps — each
@@ -319,6 +360,14 @@ def _make_step_fn(model, vocab_size, horizon, all_greedy):
     return jax.jit(step, donate_argnums=(1, 2))
 
 
+@compile_contract(
+    "engine.mixed_step",
+    max_variants=24,  # 2 specializations x (log2(chunk budget)+1) widths
+    collectives={"single": frozenset()},
+    tmp_bytes_budget=4 << 20,
+    notes="pow2 chunk-width buckets x {greedy, mixed}; the engine "
+          "passes 2*len(mixed_width_buckets(prefill_chunk_tokens)) "
+          "at mint time")
 def _make_mixed_step_fn(model, vocab_size, width, all_greedy):
     """The jitted MIXED prefill+decode step (chunked admission), traced
     once per (engine, pow2 width bucket, greedy specialization): every
@@ -391,6 +440,14 @@ def _make_mixed_step_fn(model, vocab_size, width, all_greedy):
     return jax.jit(step, donate_argnums=(1, 2))
 
 
+@compile_contract(
+    "engine.prefill_bucket",
+    max_variants=8,  # == DecodeEngine._PREFILL_CACHE_CAP: the LRU
+    # eviction path release_variant()s, so the live count IS the cache
+    collectives={"single": frozenset()},
+    tmp_bytes_budget=8 << 20,
+    notes="whole-prompt mode only; one executable per prefill bucket, "
+          "LRU-bounded — eviction releases the variant")
 def _make_prefill_fn(model, prefill_len, page_size):
     """Bucketed prefill, traced once per bucket: one causal forward over
     the prompt's bucket prefix through dense per-layer caches, whose
@@ -420,6 +477,14 @@ def _make_prefill_fn(model, prefill_len, page_size):
     return jax.jit(prefill, donate_argnums=(1, 2))
 
 
+@compile_contract(
+    "engine.spec_verify",
+    max_variants=2,  # ONE width (spec_decode_k+1) x {greedy, mixed}
+    collectives={"single": frozenset()},
+    tmp_bytes_budget=4 << 20,
+    notes="all spec traffic verifies through width spec_decode_k+1; "
+          "shorter drafts pad via chunk_lens — per-draft-length buckets "
+          "are a contract violation (tests/test_spec_decode.py)")
 def _make_spec_step_fn(model, vocab_size, width, all_greedy):
     """The jitted SPECULATIVE verification step, traced once per
     (engine, width = spec_decode_k + 1, greedy specialization): every
@@ -494,6 +559,13 @@ def _make_spec_step_fn(model, vocab_size, width, all_greedy):
     return jax.jit(step, donate_argnums=(1, 2))
 
 
+@compile_contract(
+    "engine.page_copy",
+    max_variants=1,  # src/dst are traced scalars: ONE executable ever
+    collectives={"single": frozenset()},
+    tmp_bytes_budget=1 << 20,
+    notes="the prefix cache's COW copy; a second variant would mean "
+          "src/dst leaked into the static signature")
 def _make_page_copy_fn():
     """One jitted whole-page pool copy (the prefix cache's
     copy-on-write): page `dst` becomes a private replica of shared page
@@ -641,7 +713,8 @@ class DecodeEngine:
         # so traffic can never mint per-draft-length buckets
         # (tests/test_spec_decode.py pins the count)
         self._spec_fns: dict = {}  # (width, greedy) -> jitted
-        self._copy_fn = _make_page_copy_fn()
+        self._copy_fn = _make_page_copy_fn(
+            contract_key=(), contract_owner=self, contract_budget=1)
         # whole-prompt prefill executables, LRU-bounded like the pp
         # decode cache (api.py _pp_decode_fn): prompt buckets are an
         # unbounded key space across traffic
@@ -794,6 +867,8 @@ class DecodeEngine:
             return fn
         while len(self._prefill_fns) >= self._PREFILL_CACHE_CAP:
             old, _ = self._prefill_fns.popitem(last=False)
+            # the budget counts LIVE executables: eviction un-counts
+            release_variant("engine.prefill_bucket", old, owner=self)
             _logger.warning(
                 "prefill executable cache full (%d): evicting LRU bucket "
                 "%d; the next prompt at that bucket recompiles its "
@@ -801,7 +876,9 @@ class DecodeEngine:
                 "avoids per-prompt buckets entirely)",
                 self._PREFILL_CACHE_CAP, old,
             )
-        fn = _make_prefill_fn(self.model, plen, self.page_size)
+        fn = _make_prefill_fn(self.model, plen, self.page_size,
+                              contract_key=plen, contract_owner=self,
+                              contract_budget=self._PREFILL_CACHE_CAP)
         self._prefill_fns[plen] = fn
         return fn
 
@@ -940,15 +1017,23 @@ class DecodeEngine:
     def _step_fn(self, horizon, all_greedy):
         key = (horizon, all_greedy)
         if key not in self._step_fns:
+            # the contract registry is the ONE executable counter: a
+            # horizon outside the pow2 bucket set blows the budget and
+            # fails HERE, at mint time (analysis/contracts.py)
             self._step_fns[key] = _make_step_fn(
-                self.model, self.vocab_size, horizon, all_greedy)
+                self.model, self.vocab_size, horizon, all_greedy,
+                contract_key=key, contract_owner=self,
+                contract_budget=2 * len(horizon_buckets(self.step_horizon)))
         return self._step_fns[key]
 
     def _mixed_fn(self, width, all_greedy):
         key = (width, all_greedy)
         if key not in self._mixed_fns:
             self._mixed_fns[key] = _make_mixed_step_fn(
-                self.model, self.vocab_size, width, all_greedy)
+                self.model, self.vocab_size, width, all_greedy,
+                contract_key=key, contract_owner=self,
+                contract_budget=2 * len(
+                    mixed_width_buckets(self.prefill_chunk_tokens)))
         return self._mixed_fns[key]
 
     def _chunk_width(self, remaining: int) -> int:
@@ -1120,8 +1205,14 @@ class DecodeEngine:
                 jnp.asarray(seeds), jnp.asarray(sample_steps),
             )
         self._last_logits = new_logits
-        chosen = np.asarray(chosen)  # (slots, hor)
-        chosen_lp = np.asarray(chosen_lp)
+        chosen = np.asarray(chosen)  # (slots, hor) — the scheduler's
+        # own data dependency: the next round cannot be built without it
+        # P0 (graft-check GR006 dogfood): the logprob matrix is an EXTRA
+        # per-round device->host transfer that most serving traffic
+        # (return_log_probs=False) never reads — fetch it only when some
+        # live request actually asked
+        want_lp = any(self._slots[i].req.return_log_probs for i in live)
+        chosen_lp = np.asarray(chosen_lp) if want_lp else None
         self._steps += hor
 
         now = time.perf_counter()
@@ -1204,8 +1295,16 @@ class DecodeEngine:
         )
         self._last_logits = new_last
         first = np.asarray(first)
-        first_lp = np.asarray(first_lp)
-        chunk_lps = np.asarray(chunk_lps)
+        # P0 (graft-check GR006 dogfood): logprob outputs transfer only
+        # when a live request asked for them — the mixed round is the
+        # chunked-prefill interference path the decode-p95 gauge
+        # watches, so every needless per-round transfer counts
+        want_lp = (s_c.req.return_log_probs
+                   or any(self._slots[i].req.return_log_probs
+                          for i in dec))
+        first_lp = np.asarray(first_lp) if want_lp else None
+        chunk_lps = (np.asarray(chunk_lps)
+                     if s_c.req.return_log_probs else None)
         self._steps += 1
         self._prefill_tokens += ln
 
@@ -1265,7 +1364,9 @@ class DecodeEngine:
         key = (width, all_greedy)
         if key not in self._spec_fns:
             self._spec_fns[key] = _make_spec_step_fn(
-                self.model, self.vocab_size, width, all_greedy)
+                self.model, self.vocab_size, width, all_greedy,
+                contract_key=key, contract_owner=self,
+                contract_budget=2)
         return self._spec_fns[key]
 
     def _draft(self, si: int) -> List[int]:
@@ -1386,10 +1487,15 @@ class DecodeEngine:
         )
         self._last_logits = new_last
         first = np.asarray(first)
-        first_lp = np.asarray(first_lp)
         gt = np.asarray(gt)
-        gt_lp = np.asarray(gt_lp)
         acc = np.asarray(acc)
+        # P0 (graft-check GR006 dogfood): the two logprob matrices are
+        # EXTRA per-round device->host transfers that logprob-less
+        # traffic (the common case) never reads — fetch them only when
+        # some live request actually asked
+        want_lp = any(self._slots[i].req.return_log_probs for i in live)
+        first_lp = np.asarray(first_lp) if want_lp else None
+        gt_lp = np.asarray(gt_lp) if want_lp else None
         self._steps += 1
         self._spec_rounds += 1
 
@@ -1404,8 +1510,10 @@ class DecodeEngine:
             # exactly a decode row), then the accepted draft run — each
             # accepted token IS the greedy target the decode scan would
             # have produced at that position
-            emit = [(int(first[i]), float(first_lp[i]))]
-            emit += [(int(gt[i, j]), float(gt_lp[i, j]))
+            emit = [(int(first[i]),
+                     float(first_lp[i]) if want_lp else 0.0)]
+            emit += [(int(gt[i, j]),
+                      float(gt_lp[i, j]) if want_lp else 0.0)
                      for j in range(a)]
             booked = 0
             for tok, lp in emit:
@@ -1488,13 +1596,7 @@ class DecodeEngine:
         n = self.slots
         zeros_i = np.zeros((n,), np.int32)
         null_pt = jnp.asarray(np.zeros_like(self._pt))
-        horizons = []
-        h = 1
-        top = 1 << (self.step_horizon.bit_length() - 1)
-        while h <= top:
-            horizons.append(h)
-            h *= 2
-        for h in horizons:
+        for h in horizon_buckets(self.step_horizon):
             (_, _, _, self._pools_k, self._pools_v) = self._step_fn(
                 h, True)(
                 self._dec_params, self._pools_k, self._pools_v,
@@ -1510,12 +1612,7 @@ class DecodeEngine:
                 jnp.asarray(zeros_i),
             )
         if self.prefill_chunk_tokens:
-            widths = {self.prefill_chunk_tokens}
-            w = 1
-            while w < self.prefill_chunk_tokens:
-                widths.add(w)
-                w *= 2
-            for w in sorted(widths):
+            for w in mixed_width_buckets(self.prefill_chunk_tokens):
                 (_, _, _, _, self._pools_k, self._pools_v) = \
                     self._mixed_fn(w, True)(
                     self._dec_params, self._pools_k, self._pools_v,
@@ -1547,6 +1644,61 @@ class DecodeEngine:
                 jnp.asarray(np.zeros(n, np.uint32)),
                 jnp.asarray(zeros_i),
             )
+
+    def audit_entry_points(self):
+        """(contract name, jitted fn, example args) for every jitted
+        entry point this engine's configuration can dispatch — the AOT
+        compile-contract audit (analysis/audit.py) lowers each one
+        against the REAL pools/params, so what it audits is exactly
+        what traffic runs. Args mirror warmup()'s idle-round
+        construction (null page table, zero lengths); nothing here
+        executes — builders are invoked (minting variants within the
+        engine's own budgets) but the returned fns are only lowered."""
+        n = self.slots
+        zeros_i = jnp.asarray(np.zeros((n,), np.int32))
+        null_pt = jnp.asarray(np.zeros_like(self._pt))
+        zeros_b = jnp.asarray(np.zeros(n, bool))
+        ones_b = jnp.asarray(np.ones(n, bool))
+        ones_f = jnp.asarray(np.ones(n, np.float32))
+        zeros_f = jnp.asarray(np.zeros(n, np.float32))
+        zeros_u = jnp.asarray(np.zeros(n, np.uint32))
+        h = horizon_buckets(self.step_horizon)[-1]
+        out = [(
+            "engine.decode_scan", self._step_fn(h, True),
+            (self._dec_params, self._pools_k, self._pools_v, null_pt,
+             zeros_i, self._last_logits, zeros_b,
+             jnp.asarray(np.zeros((n, h), np.int32)),
+             jnp.asarray(np.zeros((n, h), bool)), ones_b, ones_f,
+             zeros_i, zeros_f, zeros_u, zeros_i))]
+        if self.prefill_chunk_tokens:
+            w = mixed_width_buckets(self.prefill_chunk_tokens)[-1]
+            out.append((
+                "engine.mixed_step", self._mixed_fn(w, True),
+                (self._dec_params, self._pools_k, self._pools_v, null_pt,
+                 zeros_i, self._last_logits,
+                 jnp.asarray(np.zeros((n, w), np.int32)), zeros_i,
+                 zeros_b, jnp.asarray(0, jnp.int32), ones_b, ones_f,
+                 zeros_i, zeros_f, zeros_u, zeros_i)))
+        plen = bucket_prefill_len(min(8, self.max_context))
+        out.append((
+            "engine.prefill_bucket", self._prefill_fn(plen),
+            (self._dec_params, self._pools_k, self._pools_v,
+             jnp.asarray(np.zeros((1, plen), np.int32)),
+             jnp.asarray(self._pt[0]))))
+        if self.spec_decode_k:
+            w = self.spec_decode_k + 1
+            out.append((
+                "engine.spec_verify", self._spec_fn(w, True),
+                (self._dec_params, self._pools_k, self._pools_v, null_pt,
+                 zeros_i, self._last_logits,
+                 jnp.asarray(np.zeros((n, w), np.int32)), zeros_i,
+                 zeros_b, ones_b, ones_f, zeros_i, zeros_f, zeros_u,
+                 zeros_i)))
+        out.append((
+            "engine.page_copy", self._copy_fn,
+            (self._pools_k, self._pools_v, jnp.asarray(0, jnp.int32),
+             jnp.asarray(0, jnp.int32))))
+        return out
 
     def start(self):
         assert self._thread is None, "engine already started"

@@ -33,11 +33,33 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from megatron_llm_tpu.analysis.contracts import (
+    CompileContract,
+    register_contract,
+)
 from megatron_llm_tpu.inference.sampling import (
     NEG_INF,
     modify_logits_for_top_k,
     modify_logits_for_top_p,
 )
+
+# Module-level jits trace per (static, shape) key in jax's own call
+# cache; `bucket_prefill_len` bounds the key space and the AOT audit
+# (analysis/audit.py) lowers generate_tokens at the reference config.
+register_contract(CompileContract(
+    name="generate.tokens",
+    max_variants=None,  # counted by jax's jit cache, bounded by
+    # bucket_prefill_len at every caller (api.py, tests pin the count)
+    collectives={"single": frozenset()},
+    tmp_bytes_budget=4 << 20,  # 321 KB measured at the audit config
+    notes="the whole-batch decode loop: prefill + lax.while_loop; "
+          "variant growth is the prefill-bucket/statics key space"))
+register_contract(CompileContract(
+    name="generate.beam",
+    max_variants=None,  # _beam_step keys on (beam, V) shapes,
+    # _beam_advance on the model static — both module-level caches
+    collectives=None,  # beam rides the same forward as generate.tokens
+    notes="beam-search helpers (_beam_step, _beam_advance)"))
 
 
 class GenerateOutput(NamedTuple):
@@ -110,6 +132,7 @@ def score_tokens(model, params, tokens: jnp.ndarray) -> jnp.ndarray:
     ).squeeze(-1)
 
 
+# graft-contract: generate.tokens
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -258,6 +281,7 @@ def generate_tokens(
 # ---------------------------------------------------------------------------
 
 
+# graft-contract: generate.beam
 @functools.partial(jax.jit, static_argnames=("beam_size", "vocab_size"))
 def _beam_step(params, last_logits, scores, beam_size, vocab_size):
     """Top 2*beam (score, flat-index) candidates (ref: generation.py:336-357).
@@ -270,6 +294,7 @@ def _beam_step(params, last_logits, scores, beam_size, vocab_size):
     return jax.lax.top_k(total.reshape(-1), 2 * beam_size)
 
 
+# graft-contract: generate.beam
 @functools.partial(jax.jit, static_argnames=("model",), donate_argnums=(3,))
 def _beam_advance(model, params, toks, caches, beam_idx, token_idx, t):
     """Reorder beams, bank the chosen tokens, run one KV-cached step

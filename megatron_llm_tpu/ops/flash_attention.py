@@ -31,6 +31,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from megatron_llm_tpu.analysis.contracts import (
+    CompileContract,
+    register_contract,
+)
+
+register_contract(CompileContract(
+    name="ops.flash_attention",
+    max_variants=None,  # traced per (shape, statics) by jax's jit
+    # cache; the model's fixed (b, s, heads, d) keeps the key space to
+    # the handful of layouts a config actually runs
+    collectives={"single": frozenset()},
+    tmp_bytes_budget=2 << 20,  # 32 KB measured at the audit config
+    notes="audited on the dense XLA path (use_pallas=False): the "
+          "Pallas kernel is TPU-gated and interpret mode IS a host "
+          "callback by construction"))
+
 NEG_INF = -1e30
 # The kernels run the online softmax in the exp2 domain (scores pre-scaled
 # by log2(e)): the TPU transcendental unit computes exp2 natively, so
@@ -651,6 +667,7 @@ def _pick_blocks(s, t, d, qpk, block_q, block_k):
     return bq, bk
 
 
+# graft-contract: ops.flash_attention
 @functools.partial(jax.jit, static_argnames=("causal", "use_pallas",
                                              "block_q", "block_k",
                                              "interpret"))

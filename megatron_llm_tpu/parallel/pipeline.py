@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from megatron_llm_tpu.analysis.contracts import compile_contract
 from megatron_llm_tpu.models.norms import apply_norm
 from megatron_llm_tpu.models.rope import precompute_rope
 from megatron_llm_tpu.models.transformer import transformer_stack
@@ -1019,6 +1020,16 @@ def reshard_params_for_inference(params, ctx: ParallelContext, cfg):
     return jax.device_put(params, sh)
 
 
+@compile_contract(
+    "train.pipeline_step",
+    max_variants=8,  # num_microbatches buckets per trainer, like
+    # train.step — the trainer passes contract_key=num_microbatches
+    collectives=None,  # the per-tick stage ring needs a stage-sharded
+    # model to lower (collective-permute + tp all-reduces); the pp
+    # suites (test_pipeline, test_sp_memory) exercise the lowering —
+    # variants and markers are still contract-audited
+    notes="the pp>1 per-tick train step; pipeline_remat policies ride "
+          "inside one variant (policy is baked at build time)")
 def make_pipelined_train_step(model, tcfg, pcfg, ctx: ParallelContext):
     """train_step(params, opt_state, batch, lr, wd, rng) for pp > 1
     (ref: train_step + get_forward_backward_func, training.py:391-431).

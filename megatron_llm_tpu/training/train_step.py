@@ -24,10 +24,30 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from megatron_llm_tpu.analysis.contracts import compile_contract
 from megatron_llm_tpu.config import ModelConfig, ParallelConfig, TrainConfig
 from megatron_llm_tpu.optimizer.optimizer import OptimizerState, optimizer_step
 
 
+@compile_contract(
+    "train.step",
+    max_variants=8,  # num_microbatches buckets per trainer; the trainer
+    # passes contract_key=num_microbatches so a microbatch-schedule
+    # change that re-traces per step fails loudly at mint time
+    collectives={
+        "single": frozenset(),
+        # pinned on the audit reference config (analysis/audit.py):
+        # the TP activation/logit reductions lower to all-reduce, the
+        # GSPMD param/embedding gathers to all-gather; dp grad
+        # reduction folds into the same all-reduce family. ZeRO-1
+        # (ROADMAP item 2) is expected to ADD reduce-scatter here —
+        # that PR updates this declaration with its justification.
+        "tp2": frozenset({"all-reduce", "all-gather"}),
+        "dp2tp2": frozenset({"all-reduce", "all-gather"}),
+    },
+    tmp_bytes_budget=2 << 20,
+    notes="the one fused fwd+bwd+optimizer step; audited on tp2 and "
+          "dp2x2 CPU meshes at the tiny reference config")
 def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
     """Returns train_step(params, opt_state, batch, lr, wd, rng,
     spike_threshold).
@@ -128,6 +148,15 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
     return train_step
 
 
+@compile_contract(
+    "train.eval_step",
+    max_variants=4,  # one per eval flavor a trainer can build: plain,
+    # pipelined (pp_eval), batch-builder (generic_eval) — the trainer
+    # records those variants under the same contract at their jit sites
+    collectives=None,  # pp lowering needs a stage-sharded model; the
+    # pipeline suites exercise it — variants/markers still audited
+    notes="eval is interval-gated, not per-step; the contract exists "
+          "so the jit sites are registry-visible (GR007)")
 def make_eval_step(model):
     """ref: evaluate (training.py:754-810) inner step."""
 

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from megatron_llm_tpu.analysis.contracts import record_variant
 from megatron_llm_tpu.config import ModelConfig, ParallelConfig, TrainConfig
 from megatron_llm_tpu.optimizer import (
     OptimizerParamScheduler,
@@ -324,10 +325,17 @@ class Trainer:
                 )
 
                 fn = make_pipelined_train_step(
-                    self.model, self.tcfg, pcfg, self.ctx
+                    self.model, self.tcfg, pcfg, self.ctx,
+                    contract_key=num_microbatches, contract_owner=self,
                 )
             else:
-                fn = make_train_step(self.model, self.tcfg, pcfg)
+                fn = make_train_step(
+                    self.model, self.tcfg, pcfg,
+                    contract_key=num_microbatches, contract_owner=self,
+                )
+            # ONE jit site serves both branches:
+            # graft-contract: train.step (the pp=1 make_train_step above)
+            # graft-contract: train.pipeline_step (the pp>1 branch)
             self._train_steps[num_microbatches] = jax.jit(
                 fn, donate_argnums=(0, 1)
             )
@@ -451,7 +459,9 @@ class Trainer:
                 loss_fn = make_pipelined_loss_fn(
                     self.model, self.pcfg, self.ctx
                 )
+                record_variant("train.eval_step", "pp", owner=self)
 
+                # graft-contract: train.eval_step
                 @jax.jit
                 def pp_eval(params, batch):
                     return loss_fn(params, batch)
@@ -464,7 +474,9 @@ class Trainer:
                           "microbatch (encoder models have no pipelined "
                           "loss path)", flush=True)
                 model = self.model
+                record_variant("train.eval_step", "generic", owner=self)
 
+                # graft-contract: train.eval_step
                 @jax.jit
                 def generic_eval(params, batch):
                     n = jax.tree.leaves(batch)[0].shape[0]
@@ -481,7 +493,9 @@ class Trainer:
                     make_eval_step,
                 )
 
-                self._eval_step_fn = jax.jit(make_eval_step(self.model))
+                # graft-contract: train.eval_step
+                self._eval_step_fn = jax.jit(make_eval_step(
+                    self.model, contract_key="plain", contract_owner=self))
         eval_step = self._eval_step_fn
         total, count = 0.0, 0
         iters = max_iters if max_iters is not None else self.tcfg.eval_iters

@@ -1,0 +1,44 @@
+"""GR001 fixture: tracer-concretizing calls inside traced code.
+
+Lines expected to fire carry the trailing marker comment; the test
+asserts the finding set equals the marked-line set exactly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_item(x):
+    return x.item() + 1.0  # LINT
+
+
+@jax.jit
+def bad_float(x):
+    return float(x) * 2.0  # LINT
+
+
+@jax.jit
+def bad_int(x):
+    return int(x) + 1  # LINT
+
+
+@jax.jit
+def bad_bool(x):
+    if bool(x):  # LINT
+        return x
+    return -x
+
+
+@jax.jit
+def bad_numpy(x):
+    return np.asarray(x) + np.array(x)  # LINT  # LINT
+
+
+def _loss(x):
+    # traced through the jax.jit REFERENCE below, not a decorator —
+    # exercises the module index's def resolution
+    return float(x.sum())  # LINT
+
+
+loss_fn = jax.jit(_loss)

@@ -1,0 +1,35 @@
+"""GR001 counterpart: the idiomatic ways to do the same things."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good_cast(x):
+    # dtype changes stay on-device: astype, not float()/int()
+    return x.astype(jnp.float32) * 2.0
+
+
+@jax.jit
+def good_where(x):
+    # branchless select instead of bool(tracer)
+    return jnp.where(x > 0, x, -x)
+
+
+@jax.jit
+def good_jnp(x):
+    # jnp materialization traces; np.asarray would concretize
+    return jnp.asarray(x) + 1
+
+
+def host_side(x):
+    # NOT traced: concretization on host values is normal Python
+    arr = np.asarray(x)
+    return float(arr.sum()), int(arr.size), bool(arr.any())
+
+
+def fetch(x):
+    # fetching a COMPUTED device value on the host boundary is the
+    # supported pattern — the sync lives outside the jitted fn
+    y = good_cast(x)
+    return y.item()

@@ -1,0 +1,30 @@
+"""GR002 fixture: jax.jit constructed inside loops/comprehensions."""
+import functools
+
+import jax
+
+
+def rebuild_per_item(fns):
+    out = []
+    for f in fns:
+        out.append(jax.jit(f))  # LINT
+    return out
+
+
+def rebuild_while(f, n):
+    i, out = 0, []
+    while i < n:
+        out.append(jax.pjit(f))  # LINT
+        i += 1
+    return out
+
+
+def rebuild_comprehension(fns):
+    return [jax.jit(f) for f in fns]  # LINT
+
+
+def rebuild_partial(fns):
+    out = []
+    for f in fns:
+        out.append(functools.partial(jax.jit, static_argnums=(0,))(f))  # LINT
+    return out

@@ -1,0 +1,27 @@
+"""GR002 counterpart: hoist the jit; loop over CALLS, not construction."""
+import jax
+
+
+def build_once(f):
+    return jax.jit(f)
+
+
+def run_many(f, xs):
+    fn = jax.jit(f)  # constructed once, outside any loop
+    out = []
+    for x in xs:
+        out.append(fn(x))  # calling in a loop is the whole point
+    return out
+
+
+class CachedBuilder:
+    """The repo's LRU idiom (api._pp_decode_fn): construction happens
+    once per key, guarded by a cache lookup — never per iteration."""
+
+    def __init__(self):
+        self._cache = {}
+
+    def get(self, f, key):
+        if key not in self._cache:
+            self._cache[key] = jax.jit(f)
+        return self._cache[key]

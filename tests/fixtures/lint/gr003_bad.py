@@ -1,0 +1,14 @@
+"""GR003 fixture: unhashable static_argnums/static_argnames values."""
+import functools
+
+import jax
+
+
+def f(x, k):
+    return x * k
+
+
+bad_list = jax.jit(f, static_argnums=[1])  # LINT
+bad_set = jax.jit(f, static_argnames={"k"})  # LINT
+bad_comp = jax.jit(f, static_argnums=[i for i in (1,)])  # LINT
+bad_partial = functools.partial(jax.jit, static_argnames=["k"])(f)  # LINT

@@ -1,0 +1,15 @@
+"""GR003 counterpart: tuples and bare ints hash; strings too."""
+import functools
+
+import jax
+
+
+def f(x, k):
+    return x * k
+
+
+good_tuple = jax.jit(f, static_argnums=(1,))
+good_int = jax.jit(f, static_argnums=1)
+good_str = jax.jit(f, static_argnames="k")
+good_str_tuple = jax.jit(f, static_argnames=("k",))
+good_partial = functools.partial(jax.jit, static_argnames=("k",))(f)

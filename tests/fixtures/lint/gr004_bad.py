@@ -1,0 +1,27 @@
+"""GR004 fixture: host entropy evaluated at trace time, frozen forever."""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_timestamp(x):
+    # runs ONCE at trace: every later call sees the same "now"
+    return x + time.time()  # LINT
+
+
+@jax.jit
+def bad_py_random(x):
+    return x * random.random()  # LINT
+
+
+@jax.jit
+def bad_np_random(x):
+    return x + np.random.randn(*x.shape)  # LINT
+
+
+@jax.jit
+def bad_np_random_call(x):
+    return x + np.random.default_rng(0).random()  # LINT

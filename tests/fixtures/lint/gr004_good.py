@@ -1,0 +1,26 @@
+"""GR004 counterpart: entropy rides in as ARGUMENTS; device RNG is
+jax.random keyed per call."""
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_timestamp(x, now):
+    # the caller samples the clock; the trace sees a traced scalar
+    return x + now
+
+
+@jax.jit
+def good_device_rng(x, key):
+    # jax.random is on-device and keyed — new noise per call, same trace
+    return x + jax.random.normal(key, x.shape)
+
+
+def host_driver(fn, x):
+    # host code is allowed to touch the clock and Python RNG freely
+    now = time.time()
+    seed = random.getrandbits(32)
+    return fn(x, jnp.float32(now)), jax.random.key(seed)

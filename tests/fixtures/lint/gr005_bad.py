@@ -1,0 +1,25 @@
+"""GR005 fixture: set iteration inside traced code — the pytree
+structure it builds is hash-seed dependent, so two processes that must
+dispatch in lockstep can trace DIFFERENT executables."""
+import jax
+
+
+@jax.jit
+def bad_set_display(x):
+    out = {}
+    for name in {"wq", "wk", "wv"}:  # LINT
+        out[name] = x
+    return out
+
+
+@jax.jit
+def bad_set_call(params, x):
+    total = x
+    for k in set(params):  # LINT
+        total = total + params[k]
+    return total
+
+
+@jax.jit
+def bad_set_comprehension(x):
+    return [x * i for i in {1, 2, 3}]  # LINT

@@ -1,0 +1,29 @@
+"""GR005 counterpart: deterministic iteration orders — tuples, sorted(),
+and dicts (insertion-ordered since 3.7)."""
+import jax
+
+
+@jax.jit
+def good_tuple(x):
+    out = {}
+    for name in ("wq", "wk", "wv"):
+        out[name] = x
+    return out
+
+
+@jax.jit
+def good_sorted(params, x):
+    total = x
+    for k in sorted(params):
+        total = total + params[k]
+    return total
+
+
+@jax.jit
+def good_dict_order(params, x):
+    # dict iteration order is insertion order — stable across processes
+    # that built the pytree the same way
+    total = x
+    for k in params:
+        total = total + params[k]
+    return total

@@ -1,0 +1,17 @@
+"""GR006 fixture: host syncs on a hot per-round path. The test
+monkeypatches lint.HOT_PATHS to scope `Engine.serve_round` hot — in the
+real repo that list is engine._decode_round/_mixed_round/_spec_round
+and trainer.train/train_step."""
+import jax
+import numpy as np
+
+
+class Engine:
+    def serve_round(self, logits, toks):
+        toks_np = np.asarray(toks)  # LINT
+        logits.block_until_ready()  # LINT
+        fetched = jax.device_get(logits)  # LINT
+        copied = np.array(fetched)  # LINT
+        lp = float(logits[0])  # LINT
+        n = int(toks.sum())  # LINT
+        return toks_np, copied, lp, n

@@ -1,0 +1,19 @@
+"""GR007 fixture: jitted entry points invisible to the contract
+registry (linted with package_scope=True, as megatron_llm_tpu/ is)."""
+import functools
+
+import jax
+
+
+@jax.jit  # LINT
+def bare_entry(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("k",))  # LINT
+def bare_static_entry(x, k):
+    return x * k
+
+
+def make_step(f):
+    return jax.jit(f)  # LINT

@@ -1,0 +1,23 @@
+"""GR007 counterpart: every jit site is registry-visible — either the
+builder is @compile_contract-decorated, or the site carries a
+`# graft-contract: <name>` marker naming its contract."""
+import jax
+
+from megatron_llm_tpu.analysis.contracts import compile_contract
+
+
+# graft-contract: demo.entry
+@jax.jit
+def marked_entry(x):
+    return x + 1
+
+
+@compile_contract("demo.step", max_variants=1)
+def make_step(f):
+    # a jit inside a contract-decorated builder IS the registration
+    return jax.jit(f)
+
+
+def make_marked(f):
+    # graft-contract: demo.entry
+    return jax.jit(f)

@@ -34,6 +34,7 @@ import numpy as np
 import pytest
 
 from conftest import kernel_interpret_mode
+from megatron_llm_tpu.analysis.contracts import variants
 from megatron_llm_tpu.config import tiny_config
 from megatron_llm_tpu.inference.engine import DecodeEngine, QueueFull
 from megatron_llm_tpu.inference.generation import (
@@ -243,16 +244,23 @@ class TestChunkedPrefill:
                       step_horizon=8)
         eng.warmup()
         want = {(w, True) for w in (1, 2, 4, 8)}
-        assert want <= set(eng._step_fns)
-        assert want <= set(eng._mixed_fns)
-        step_keys = set(eng._step_fns)
-        mixed_keys = set(eng._mixed_fns)
+        # the compile-contract registry is the ONE executable counter
+        # (analysis/contracts.py); the engine's fn dicts must stay thin
+        # views of the same live-variant sets
+        assert want <= variants("engine.decode_scan", owner=eng)
+        assert want <= variants("engine.mixed_step", owner=eng)
+        assert variants("engine.decode_scan", owner=eng) \
+            == set(eng._step_fns)
+        assert variants("engine.mixed_step", owner=eng) \
+            == set(eng._mixed_fns)
+        step_keys = variants("engine.decode_scan", owner=eng)
+        mixed_keys = variants("engine.mixed_step", owner=eng)
         rs = np.random.RandomState(24)
         p = list(rs.randint(2, 256, 7))
         req = eng.submit(p, 6, top_k=1)
         eng.drain()
-        assert set(eng._step_fns) == step_keys
-        assert set(eng._mixed_fns) == mixed_keys
+        assert variants("engine.decode_scan", owner=eng) == step_keys
+        assert variants("engine.mixed_step", owner=eng) == mixed_keys
         ref_toks, _, _ = _reference(
             model, params, p, 6, termination_id=None,
             use_eod_for_early_termination=False)
@@ -271,6 +279,10 @@ class TestChunkedPrefill:
             for plen in range(1, 12):
                 eng._prefill_fn(plen)
         assert len(eng._prefill_fns) == eng._PREFILL_CACHE_CAP
+        # eviction releases its variant: the registry's LIVE count IS
+        # the cache occupancy (the contract's whole point)
+        assert variants("engine.prefill_bucket", owner=eng) \
+            == set(eng._prefill_fns)
         assert any("evicting LRU bucket" in r.message
                    for r in caplog.records)
         # requeue-on-hit: touching the LRU head saves it
@@ -278,6 +290,7 @@ class TestChunkedPrefill:
         eng._prefill_fn(head)
         eng._prefill_fn(99)
         assert head in eng._prefill_fns
+        assert head in variants("engine.prefill_bucket", owner=eng)
 
     def test_latency_gauges_flow(self, tiny_model):
         """ttft/decode-latency gauges populate and ride the timers

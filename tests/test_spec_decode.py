@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from megatron_llm_tpu.analysis.contracts import variants
 from megatron_llm_tpu.config import tiny_config
 from megatron_llm_tpu.inference.engine import DecodeEngine
 from megatron_llm_tpu.inference.generation import (
@@ -207,17 +208,25 @@ class TestSchedulingAndGuards:
             eng.submit(CYCLE_PROMPT, gen, top_k=1)
             eng.submit([7, 8] * 4, gen // 2, top_k=1)
             eng.drain()
+        # the compile-contract registry is the ONE executable counter
+        # (analysis/contracts.py, contract "engine.spec_verify"); the
+        # engine's _spec_fns dict must stay a thin view of it
+        assert variants("engine.spec_verify", owner=eng) \
+            == {(k + 1, True)}
         assert set(eng._spec_fns) == {(k + 1, True)}
         # sampled alongside greedy: ONE more specialization, same width
         eng.submit(CYCLE_PROMPT, 16, top_k=1)
         eng.submit(list(rs.randint(2, 256, 6)), 6, top_k=5, seed=3)
         eng.drain()
-        assert set(eng._spec_fns) <= {(k + 1, True), (k + 1, False)}
-        minted = set(eng._spec_fns)
+        assert variants("engine.spec_verify", owner=eng) \
+            <= {(k + 1, True), (k + 1, False)}
+        assert set(eng._spec_fns) \
+            == variants("engine.spec_verify", owner=eng)
+        minted = variants("engine.spec_verify", owner=eng)
         for _ in range(2):  # steady-state traffic mints nothing new
             eng.submit(CYCLE_PROMPT, 12, top_k=1)
             eng.drain()
-        assert set(eng._spec_fns) == minted
+        assert variants("engine.spec_verify", owner=eng) == minted
 
     def test_warmup_pretraces_spec_executable(self, tiny_model):
         model, params = tiny_model
