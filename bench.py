@@ -39,6 +39,15 @@ per-round decode-latency p95 for both, `chunked_vs_wholeprompt_ttft`
 as the headline ratio, per-round prefill-token maxima as the budget
 audit, methodology stated in-row.
 
+Round-11 audit keys (ISSUE 9): `extra.quant` quantizes the serving hot
+path — bf16 vs int8-KV (and +weight-only-int8) engines on identical
+greedy traffic: decode tok/s ratio (`int8_vs_bf16_decode_tok_s`
+headline), KV bytes/token derived from the live pools (the capacity
+doubling), a standalone paged-attention GB/s pair at the same traffic,
+and max teacher-forced prompt-logprob drift vs bf16 stated in-row; the
+decode roofline row now derives cache bytes from the active cache
+dtype instead of hard-coding bf16.
+
 Round-10 audit keys (ISSUE 5): `extra.ckpt` measures the
 fault-tolerance claim — train-loop stall per checkpoint under the async
 CheckpointManager (device→host copy only) vs the synchronous
@@ -588,6 +597,178 @@ def serving_prefix_stats(model, params, *, slots=4, page_size=64,
     }
 
 
+def quant_paged_op_stats(slots=8, T=512, page_size=64):
+    """Standalone paged decode-attention op, bf16 vs int8 pools at the
+    SAME traffic (same slots, same per-slot lengths, same page tables):
+    per-call time, decode-HBM bytes/token per dtype (derived from the
+    ACTUAL pool dtypes, never hard-coded), and achieved GB/s for both —
+    the kernel-level half of the `extra.quant` row. On TPU the int8 row
+    should show ~the same wall time at ~half the bytes (the kernel is
+    bandwidth-bound), i.e. honest GB/s near parity and bytes/token
+    halved."""
+    from megatron_llm_tpu.ops.decode_attention import (
+        paged_decode_attention,
+    )
+    from megatron_llm_tpu.ops.quantization import quantize_rows
+
+    import numpy as np
+
+    cfg = make_cfg(1024)
+    g, qpk, d = cfg.num_query_groups, cfg.q_per_kv, cfg.head_dim
+    mp = T // page_size
+    num_pages = 1 + slots * mp
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (slots, 1, g, qpk, d), jnp.bfloat16)
+    kpf = jax.random.normal(ks[1], (num_pages, page_size, g, d),
+                            jnp.bfloat16)
+    vpf = jax.random.normal(ks[2], (num_pages, page_size, g, d),
+                            jnp.bfloat16)
+    rs = np.random.RandomState(0)
+    pt = jnp.asarray((rs.permutation(num_pages - 1) + 1)
+                     .reshape(slots, mp), jnp.int32)
+    lengths = jnp.full((slots,), T, jnp.int32)
+
+    t_bf16 = _timed_scan(
+        lambda q, kp, vp: paged_decode_attention(q, kp, vp, pt, lengths),
+        (q, kpf, vpf))
+    kq, ksc = quantize_rows(kpf)
+    vq, vsc = quantize_rows(vpf)
+    t_int8 = _timed_scan(
+        lambda q, kp, vp, ksx, vsx: paged_decode_attention(
+            q, kp, vp, pt, lengths, k_scales=ksx, v_scales=vsx),
+        (q, kq, vq, ksc, vsc))
+    # cache bytes one call actually streams, from the pool dtypes
+    bpt_bf16 = 2 * g * d * kpf.dtype.itemsize
+    bpt_int8 = 2 * g * (d * kq.dtype.itemsize + ksc.dtype.itemsize)
+    return {
+        "slots": slots, "tokens_per_slot": T,
+        "paged_attn_us_bf16": round(t_bf16 * 1e6, 2),
+        "paged_attn_us_int8": round(t_int8 * 1e6, 2),
+        "cache_bytes_per_token_bf16": bpt_bf16,
+        "cache_bytes_per_token_int8": bpt_int8,
+        "cache_bytes_per_token_reduction": round(
+            1.0 - bpt_int8 / bpt_bf16, 4),
+        "paged_attn_gbps_bf16": round(
+            slots * T * bpt_bf16 / t_bf16 / 1e9, 1),
+        "paged_attn_gbps_int8": round(
+            slots * T * bpt_int8 / t_int8 / 1e9, 1),
+    }
+
+
+def quant_serving_stats(model, params, *, slots=4, page_size=64,
+                        max_context=640, vocab_size=32000, n_requests=8,
+                        prompt_len=192, gen=64, chunk=128):
+    """The engine half of `extra.quant` (ISSUE 9): bf16 vs int8-KV vs
+    int8-KV + weight-only-int8 engines on IDENTICAL greedy traffic.
+    Methodology (stated in the emitted row): same prompts, same budget,
+    all engines chunked and compile-warmed off the clock; decode tok/s
+    comes from the engine's own round log restricted to pure decode
+    rounds (prefill rounds excluded, so the ratio isolates the
+    bandwidth win); accuracy is max |Δ logprob| against the bf16 run
+    over the TEACHER-FORCED prompt positions of the fixed prompt set —
+    generated positions diverge with the stream, prompt positions score
+    the same context — plus the fraction of requests whose greedy
+    token streams match bitwise."""
+    import numpy as np
+
+    from megatron_llm_tpu.inference.engine import DecodeEngine
+
+    rs = np.random.RandomState(0)
+    prompts = [list(rs.randint(2, vocab_size, prompt_len))
+               for _ in range(n_requests)]
+    modes = (("bf16", "bf16", False), ("int8", "int8", False),
+             ("int8_w", "int8", True))
+    rows, lps, toks = {}, {}, {}
+    for mode, kv, qw in modes:
+        eng = DecodeEngine(
+            model, params, slots=slots, page_size=page_size,
+            max_context=max_context, max_queue=n_requests,
+            termination_id=None, vocab_size=vocab_size,
+            prefill_chunk_tokens=chunk, kv_dtype=kv,
+            quantize_weights=qw)
+        eng.submit(prompts[0], 2, top_k=1)
+        eng.drain()
+        eng.warmup()
+        with eng._lock:
+            eng._round_log.clear()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, gen, top_k=1, return_log_probs=True)
+                for p in prompts]
+        eng.drain()
+        makespan = max(r.t_done for r in reqs) - t0
+        with eng._lock:
+            log = list(eng._round_log)
+        dec_tok = sum(r["decode_slots"] * r["decode_steps"]
+                      for r in log if not r["prefill_tokens"])
+        dec_ms = sum(r["ms"] for r in log if not r["prefill_tokens"])
+        outs = [r.result() for r in reqs]
+        lps[mode] = [lp[:prompt_len - 1] for _, lp in outs]
+        toks[mode] = [t for t, _ in outs]
+        rows[mode] = {
+            "tok_s": round(n_requests * gen / makespan, 1),
+            "decode_tok_s": round(dec_tok / max(dec_ms / 1e3, 1e-9), 1),
+            "kv_bytes_per_token": eng.kv_bytes_per_token(),
+            "kv_pool_bytes": eng.kv_pool_bytes(),
+        }
+    for mode in ("int8", "int8_w"):
+        rows[mode]["max_prompt_logprob_drift_vs_bf16"] = round(max(
+            abs(a - b)
+            for ref, got in zip(lps["bf16"], lps[mode])
+            for a, b in zip(ref, got)), 5)
+        rows[mode]["greedy_token_match_frac"] = round(sum(
+            t1 == t2 for t1, t2 in zip(toks["bf16"], toks[mode])
+        ) / n_requests, 3)
+    bpt_bf16 = rows["bf16"]["kv_bytes_per_token"]
+    bpt_int8 = rows["int8"]["kv_bytes_per_token"]
+    capacity = bpt_bf16 / bpt_int8
+    return {
+        "requests": n_requests, "prompt_len": prompt_len, "gen": gen,
+        "slots": slots,
+        "bf16": rows["bf16"], "int8": rows["int8"],
+        "int8_w": rows["int8_w"],
+        "int8_vs_bf16_decode_tok_s": round(
+            rows["int8"]["decode_tok_s"]
+            / max(rows["bf16"]["decode_tok_s"], 1e-9), 2),
+        "int8_w_vs_bf16_decode_tok_s": round(
+            rows["int8_w"]["decode_tok_s"]
+            / max(rows["bf16"]["decode_tok_s"], 1e-9), 2),
+        # pages-per-HBM-byte multiple AND its slot-count reading: the
+        # SAME pool bytes hold capacity x the max_context slots
+        "kv_capacity_ratio": round(capacity, 2),
+        "tokens_per_gib_bf16": int(2**30 // bpt_bf16),
+        "tokens_per_gib_int8": int(2**30 // bpt_int8),
+        "max_context_slots_per_bf16_pool": slots,
+        "max_context_slots_per_bf16_pool_at_int8": int(
+            rows["bf16"]["kv_pool_bytes"]
+            // (bpt_int8 * max_context)),
+        "methodology": (
+            "identical greedy traffic all three engines (same prompts/"
+            "budgets, chunked, compile-warmed off the clock); decode "
+            "tok/s = decode-round tokens / decode-round wall from the "
+            "engine round log (prefill rounds excluded); drift = max "
+            "|Δ logprob| vs the bf16 run over teacher-forced PROMPT "
+            "positions of the fixed prompt set (generated positions "
+            "follow their own stream); token match = fraction of "
+            "requests with bitwise-equal greedy streams; bytes/token "
+            "derived from the live pool arrays (data + scales)"
+        ),
+    }
+
+
+def run_quant(slots=8):
+    """bench-model `extra.quant` row (ISSUE 9): the int8-KV capacity
+    and bandwidth claims measured, with the accuracy drift bound stated
+    in the same row."""
+    import dataclasses
+
+    cfg = dataclasses.replace(make_cfg(1024), params_dtype=jnp.bfloat16)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    out = quant_serving_stats(model, params, slots=slots)
+    out["paged_attn_op"] = quant_paged_op_stats(slots=slots)
+    return out
+
+
 def run_serving(n_requests=16, slots=8):
     """bench-model serving row (bf16 decode weights, decode kernel on):
     the ISSUE-3 continuous-vs-static comparison, the ISSUE-4
@@ -748,7 +929,10 @@ def decode_attn_op_stats(b=8, T=576):
     t_xla = _timed_scan(
         lambda q, k, v: decode_attention(q, k, v, length, layout="gtd",
                                          use_pallas=False), (q, k, v))
-    cache_bytes = 2 * b * g * T * d * 2  # K + V, bf16
+    # K + V bytes DERIVED from the cache array's actual dtype — a
+    # hard-coded bf16 itemsize here would overstate achieved GB/s the
+    # moment a quantized cache rides this row (ISSUE 9 small fix)
+    cache_bytes = 2 * b * g * T * d * k.dtype.itemsize
     return {
         "decode_attn_us_b8": round(t_kernel * 1e6, 2),
         "decode_attn_us_b8_xla": round(t_xla * 1e6, 2),
@@ -934,6 +1118,7 @@ def main():
     attn_stats = decode_attn_op_stats(b=8, T=64 + gen)
     mxu = flash_mxu_stats()
     serving = run_serving()
+    quant = run_quant()
     ckpt = run_ckpt_bench()
     achieved = tok1 * 6 * n_params
     baseline = 890.0 * 6 * 7.0e9  # A100 anchor, BASELINE.md
@@ -971,6 +1156,16 @@ def main():
             f"prefill tokens/request "
             f"-{serving['prefix']['prefill_token_reduction']:.0%}, "
             f"peak pages -{serving['prefix']['peak_pages_in_use_delta']}"
+            f"; int8 KV pages: "
+            f"{quant['int8_vs_bf16_decode_tok_s']}x decode tok/s, "
+            f"{quant['kv_capacity_ratio']}x tokens/HBM-byte "
+            f"({quant['bf16']['kv_bytes_per_token']} -> "
+            f"{quant['int8']['kv_bytes_per_token']} B/token), max prompt "
+            f"logprob drift "
+            f"{quant['int8']['max_prompt_logprob_drift_vs_bf16']} "
+            f"(+int8 weights: "
+            f"{quant['int8_w_vs_bf16_decode_tok_s']}x, drift "
+            f"{quant['int8_w']['max_prompt_logprob_drift_vs_bf16']})"
             f"; async ckpt blocks the loop "
             f"{ckpt['async_blocked_ms']:.0f}ms = "
             f"{ckpt['async_vs_sync_stall']:.0%} of the "
@@ -1000,6 +1195,7 @@ def main():
             **attn_stats,
             "decode_step_breakdown_b8": breakdown,
             "serving": serving,
+            "quant": quant,
             "ckpt": ckpt,
         },
     }))

@@ -19,7 +19,10 @@ a production shape exists.
 
 Entry points audited (the registry's lowerable surface):
 - the five engine builders, through `DecodeEngine.audit_entry_points()`
-  against the engine's REAL pools (mesh tag "single");
+  against the engine's REAL pools (mesh tag "single") — TWICE: once on
+  an fp engine and once on an int8-KV + weight-only-int8 engine
+  (ISSUE 9), so the quantized step programs meet the same contract;
+- `ops.weight_quant`, the one-shot fp->int8 decode-weight quantizer;
 - `train.step` on tp2 AND dp2x2 meshes — the two forecast mesh shapes
   whose collective inventories ROADMAP items 1/2/4 will be verified
   against;
@@ -197,27 +200,48 @@ def _tiny_model():
 
 
 def _audit_engine() -> List[TargetResult]:
-    """The five engine entry points, lowered against a real (tiny)
-    engine with chunked prefill AND speculative decoding configured so
-    every builder is reachable. Also checks the config-derived bucket
-    budgets stay within each contract's declared max_variants — the
-    same helpers (horizon_buckets / mixed_width_buckets) the engine
-    passes at mint time, so the audit and the runtime cannot drift."""
+    """The engine entry points, lowered against real (tiny) engines —
+    one fp engine with chunked prefill AND speculative decoding
+    configured so every builder is reachable, and one QUANTIZED engine
+    (kv_dtype int8 + weight-only int8 decode matmuls, ISSUE 9) so the
+    quantized step programs are audited to the same contract as the fp
+    paths (same collective inventory, no host callbacks / fp64, temp
+    budgets). Also checks the config-derived bucket budgets stay within
+    each contract's declared max_variants — the same helpers
+    (horizon_buckets / mixed_width_buckets) the engine passes at mint
+    time, so the audit and the runtime cannot drift; kv_dtype is an
+    engine-level choice and must never mint extra variants (the two
+    engines are two owners with identical bucket budgets)."""
     from megatron_llm_tpu.inference.engine import (
         DecodeEngine,
         horizon_buckets,
         mixed_width_buckets,
     )
+    from megatron_llm_tpu.ops.quantization import weight_quant_fn
 
     model, params = _tiny_model()
     eng = DecodeEngine(
         model, params, slots=2, page_size=16, max_context=64,
         step_horizon=8, prefill_chunk_tokens=16, spec_decode_k=2,
         vocab_size=256)
+    eng_q = DecodeEngine(
+        model, params, slots=2, page_size=16, max_context=64,
+        step_horizon=8, prefill_chunk_tokens=16, spec_decode_k=2,
+        kv_dtype="int8", quantize_weights=True, vocab_size=256)
 
     results = []
     for name, fn, args in eng.audit_entry_points():
         results.append(audit_lowered(name, "single", fn, args))
+    for name, fn, args in eng_q.audit_entry_points():
+        res = audit_lowered(name, "single", fn, args)
+        res.facts["quantized"] = True  # int8 KV + int8 decode weights
+        results.append(res)
+    # the one-shot weight quantizer itself (fp decode tree -> weight-
+    # only int8): a registered jitted entry point like any other
+    fp_layers = model.prepare_decode_params(params)["layers"]
+    wq = audit_lowered("ops.weight_quant", "single", weight_quant_fn(),
+                       (fp_layers,))
+    results.append(wq)
 
     budgets = {
         "engine.decode_scan": 2 * len(horizon_buckets(eng.step_horizon)),
@@ -226,6 +250,7 @@ def _audit_engine() -> List[TargetResult]:
         "engine.prefill_bucket": eng._PREFILL_CACHE_CAP,
         "engine.spec_verify": 2,
         "engine.page_copy": 1,
+        "ops.weight_quant": 1,
     }
     for res in results:
         contract = get_contract(res.contract)

@@ -81,6 +81,17 @@ ISSUE 6 adds three compounding serving features on the same pool:
   slot's host-authoritative length back, so stale K/V past the accepted
   position is overwritten by the next round's writes and never read
   (the kernels mask by length).
+
+ISSUE 9 quantizes the serving hot path, both bandwidth levers at once:
+`kv_dtype="int8"` stores the page pools as int8 with per-(token, group)
+fp32 scale pools riding every jitted step beside the data (quantize at
+scatter, dequantize in-register — ops/quantization.py is the ONE
+convention; COW page copies and null-page routing carry scales with
+their pages, and the host-side refcount/eviction accounting never sees
+a dtype), and `quantize_weights=True` swaps the decode GEMV weights for
+one-shot weight-only int8. Both default OFF: the fp path keeps its
+bitwise generate_tokens parity; the int8 path's accuracy is a measured
+drift bound (bench `extra.quant`, docs/GUIDE.md "Quantized serving").
 """
 
 from __future__ import annotations
@@ -299,7 +310,8 @@ class _Slot:
     tmp_bytes_budget=1 << 20,
     notes="pow2-bucketed scan horizons x {greedy, mixed}; the engine "
           "passes the config-derived budget "
-          "2*len(horizon_buckets(step_horizon)) at mint time")
+          "2*len(horizon_buckets(step_horizon)) at mint time; kv_dtype "
+          "is an engine-level choice, never a new variant key")
 def _make_step_fn(model, vocab_size, horizon, all_greedy):
     """The jitted continuous-batching step, traced once per (engine,
     horizon bucket): a lax.scan of `horizon` single-token steps — each
@@ -310,16 +322,22 @@ def _make_step_fn(model, vocab_size, horizon, all_greedy):
     tunnel one dispatch can cost more than the step itself); the host
     clamps the horizon to the nearest slot completion, so no request
     ever overruns its budget inside a horizon. Page pools are donated —
-    the update is in place."""
+    the update is in place. Int8 engines (ISSUE 9) pass the fp32 scale
+    pools as pools_ks/pools_vs (donated, updated alongside the data in
+    the scan carry); fp engines pass empty tuples and trace the same
+    program they always did."""
 
-    def step(dec_params, pools_k, pools_v, page_table, lengths,
-             last_logits, active, forced, use_forced, greedy, temperature,
-             top_k, top_p, seeds, sample_steps):
+    def step(dec_params, pools_k, pools_v, pools_ks, pools_vs,
+             page_table, lengths, last_logits, active, forced,
+             use_forced, greedy, temperature, top_k, top_p, seeds,
+             sample_steps):
         # forced/use_forced: (slots, horizon) — the remaining prompt
         # tokens are known in advance, so teacher forcing rides the scan
+        quant = len(pools_ks) > 0  # int8 pools carry scale pools
 
         def body(carry, xs):
-            pools_k, pools_v, lengths, last_logits, steps_c = carry
+            pools_k, pools_v, pools_ks, pools_vs, lengths, last_logits, \
+                steps_c = carry
             forced_t, use_forced_t = xs
             lp_full = jax.nn.log_softmax(
                 last_logits.astype(jnp.float32), axis=-1)
@@ -340,24 +358,36 @@ def _make_step_fn(model, vocab_size, horizon, all_greedy):
             caches = {"k_pages_layers": pools_k,
                       "v_pages_layers": pools_v,
                       "page_table": page_table, "lengths": lengths}
+            if quant:
+                caches["k_scales_layers"] = pools_ks
+                caches["v_scales_layers"] = pools_vs
             logits, new_caches = model.forward(
                 dec_params, chosen[:, None], kv_caches=caches,
                 position_ids=lengths[:, None],
             )
             steps_c = steps_c + (active & ~use_forced_t)
+            # carry the logits at last_logits' dtype (fp32): a bf16-
+            # compute model would otherwise flip the scan carry dtype
+            # on the first step and fail trace (no-op for fp32 models,
+            # so the bitwise-parity engines are untouched)
             return ((new_caches["k_pages_layers"],
                      new_caches["v_pages_layers"],
-                     new_caches["lengths"], logits[:, 0], steps_c),
+                     new_caches.get("k_scales_layers", ()),
+                     new_caches.get("v_scales_layers", ()),
+                     new_caches["lengths"],
+                     logits[:, 0].astype(last_logits.dtype), steps_c),
                     (chosen, chosen_lp))
 
-        carry = (pools_k, pools_v, lengths, last_logits, sample_steps)
+        carry = (pools_k, pools_v, pools_ks, pools_vs, lengths,
+                 last_logits, sample_steps)
         carry, (chosen_h, lp_h) = jax.lax.scan(
             body, carry, (forced.T, use_forced.T))
-        pools_k, pools_v, _, last_logits, _ = carry
+        pools_k, pools_v, pools_ks, pools_vs, _, last_logits, _ = carry
         # (horizon, slots) -> (slots, horizon)
-        return (chosen_h.T, lp_h.T, last_logits, pools_k, pools_v)
+        return (chosen_h.T, lp_h.T, last_logits, pools_k, pools_v,
+                pools_ks, pools_vs)
 
-    return jax.jit(step, donate_argnums=(1, 2))
+    return jax.jit(step, donate_argnums=(1, 2, 3, 4))
 
 
 @compile_contract(
@@ -386,10 +416,10 @@ def _make_mixed_step_fn(model, vocab_size, width, all_greedy):
     the new last logits, and the pools. last_logits is PRESERVED for
     idle slots."""
 
-    def step(dec_params, pools_k, pools_v, page_table, lengths,
-             last_logits, chunk_tokens, chunk_lens, is_prefill,
-             chunk_idx, greedy, temperature, top_k, top_p, seeds,
-             sample_steps):
+    def step(dec_params, pools_k, pools_v, pools_ks, pools_vs,
+             page_table, lengths, last_logits, chunk_tokens, chunk_lens,
+             is_prefill, chunk_idx, greedy, temperature, top_k, top_p,
+             seeds, sample_steps):
         active = chunk_lens > 0
         lp_full = jax.nn.log_softmax(
             last_logits.astype(jnp.float32), axis=-1)
@@ -407,6 +437,9 @@ def _make_mixed_step_fn(model, vocab_size, width, all_greedy):
         caches = {"k_pages_layers": pools_k, "v_pages_layers": pools_v,
                   "page_table": page_table, "lengths": lengths,
                   "chunk_lens": chunk_lens}
+        if len(pools_ks) > 0:  # int8 pools carry scale pools
+            caches["k_scales_layers"] = pools_ks
+            caches["v_scales_layers"] = pools_vs
         logits, new_caches = model.forward(
             dec_params, toks, kv_caches=caches,
             position_ids=lengths[:, None] + jnp.arange(width)[None, :],
@@ -432,12 +465,18 @@ def _make_mixed_step_fn(model, vocab_size, width, all_greedy):
         last_idx = jnp.clip(chunk_lens - 1, 0, width - 1)
         new_last = jnp.take_along_axis(
             logits, last_idx[:, None, None], axis=1)[:, 0]
-        new_last = jnp.where(active[:, None], new_last, last_logits)
+        # keep last_logits' dtype (fp32; bf16-compute models upcast
+        # here — no-op for fp32 models)
+        new_last = jnp.where(active[:, None],
+                             new_last.astype(last_logits.dtype),
+                             last_logits)
         return (first, first_lp, chunk_lps, new_last,
                 new_caches["k_pages_layers"],
-                new_caches["v_pages_layers"])
+                new_caches["v_pages_layers"],
+                new_caches.get("k_scales_layers", ()),
+                new_caches.get("v_scales_layers", ()))
 
-    return jax.jit(step, donate_argnums=(1, 2))
+    return jax.jit(step, donate_argnums=(1, 2, 3, 4))
 
 
 @compile_contract(
@@ -453,10 +492,14 @@ def _make_prefill_fn(model, prefill_len, page_size):
     the prompt's bucket prefix through dense per-layer caches, whose
     K/V rows are scattered STRAIGHT into the slot's pool pages inside
     the same jitted program (XLA fuses the relayout with the cache
-    write). Returns updated pools, the slot's next-token logits, and
-    the prompt logprobs of the prefix."""
+    write). Int8 pools quantize each (token, group) row at the same
+    scatter (the dense prefill math itself stays fp — quantization is a
+    storage decision, ops/quantization.py). Returns updated pools, the
+    slot's next-token logits, and the prompt logprobs of the prefix."""
 
-    def prefill(dec_params, pools_k, pools_v, tokens, pt_row):
+    def prefill(dec_params, pools_k, pools_v, pools_ks, pools_vs,
+                tokens, pt_row):
+        quant = len(pools_ks) > 0
         caches = model.init_kv_caches(1, prefill_len, layout="layers")
         logits, caches = model.forward(dec_params, tokens,
                                        kv_caches=caches)
@@ -466,15 +509,37 @@ def _make_prefill_fn(model, prefill_len, page_size):
         pos = jnp.arange(prefill_len)
         pages = pt_row[pos // page_size]
         offs = pos % page_size
+        if quant:
+            # quantize-at-write through the ONE shared definition —
+            # the same rounding/scale convention as the chunked and
+            # decode scatter paths (ops/quantization.py)
+            from megatron_llm_tpu.ops.quantization import (
+                scatter_quantized_rows,
+            )
+
+            new_k, new_v, new_ks, new_vs = [], [], [], []
+            for pk, pv, pks, pvs, kl, vl in zip(
+                    pools_k, pools_v, pools_ks, pools_vs,
+                    caches["k_layers"], caches["v_layers"]):
+                pk, pks = scatter_quantized_rows(
+                    pk, pks, pages, offs, kl[0].transpose(1, 0, 2))
+                pv, pvs = scatter_quantized_rows(
+                    pv, pvs, pages, offs, vl[0].transpose(1, 0, 2))
+                new_k.append(pk)
+                new_v.append(pv)
+                new_ks.append(pks)
+                new_vs.append(pvs)
+            return (tuple(new_k), tuple(new_v), tuple(new_ks),
+                    tuple(new_vs), logits[0, -1], prompt_lp)
         pools_k = tuple(
             pk.at[pages, offs].set(kl[0].transpose(1, 0, 2))
             for pk, kl in zip(pools_k, caches["k_layers"]))
         pools_v = tuple(
             pv.at[pages, offs].set(vl[0].transpose(1, 0, 2))
             for pv, vl in zip(pools_v, caches["v_layers"]))
-        return pools_k, pools_v, logits[0, -1], prompt_lp
+        return pools_k, pools_v, (), (), logits[0, -1], prompt_lp
 
-    return jax.jit(prefill, donate_argnums=(1, 2))
+    return jax.jit(prefill, donate_argnums=(1, 2, 3, 4))
 
 
 @compile_contract(
@@ -509,9 +574,10 @@ def _make_spec_step_fn(model, vocab_size, width, all_greedy):
     values), the accepted counts, the new last logits (preserved for
     idle slots), and the donated pools."""
 
-    def step(dec_params, pools_k, pools_v, page_table, lengths,
-             last_logits, chunk_tokens, chunk_lens, is_spec, greedy,
-             temperature, top_k, top_p, seeds, sample_steps):
+    def step(dec_params, pools_k, pools_v, pools_ks, pools_vs,
+             page_table, lengths, last_logits, chunk_tokens, chunk_lens,
+             is_spec, greedy, temperature, top_k, top_p, seeds,
+             sample_steps):
         active = chunk_lens > 0
         lp_full = jax.nn.log_softmax(
             last_logits.astype(jnp.float32), axis=-1)
@@ -528,6 +594,9 @@ def _make_spec_step_fn(model, vocab_size, width, all_greedy):
         caches = {"k_pages_layers": pools_k, "v_pages_layers": pools_v,
                   "page_table": page_table, "lengths": lengths,
                   "chunk_lens": chunk_lens}
+        if len(pools_ks) > 0:  # int8 pools carry scale pools
+            caches["k_scales_layers"] = pools_ks
+            caches["v_scales_layers"] = pools_vs
         logits, new_caches = model.forward(
             dec_params, toks, kv_caches=caches,
             position_ids=lengths[:, None] + jnp.arange(width)[None, :],
@@ -551,12 +620,16 @@ def _make_spec_step_fn(model, vocab_size, width, all_greedy):
             is_spec, acc, jnp.clip(chunk_lens - 1, 0, width - 1))
         new_last = jnp.take_along_axis(
             logits, last_idx[:, None, None], axis=1)[:, 0]
-        new_last = jnp.where(active[:, None], new_last, last_logits)
+        new_last = jnp.where(active[:, None],
+                             new_last.astype(last_logits.dtype),
+                             last_logits)
         return (first, first_lp, gt, gt_lp, acc, new_last,
                 new_caches["k_pages_layers"],
-                new_caches["v_pages_layers"])
+                new_caches["v_pages_layers"],
+                new_caches.get("k_scales_layers", ()),
+                new_caches.get("v_scales_layers", ()))
 
-    return jax.jit(step, donate_argnums=(1, 2))
+    return jax.jit(step, donate_argnums=(1, 2, 3, 4))
 
 
 @compile_contract(
@@ -569,16 +642,21 @@ def _make_spec_step_fn(model, vocab_size, width, all_greedy):
 def _make_page_copy_fn():
     """One jitted whole-page pool copy (the prefix cache's
     copy-on-write): page `dst` becomes a private replica of shared page
-    `src` across every layer's K and V pool. src/dst are traced
+    `src` across every layer's K and V pool — AND, on an int8 engine,
+    across every layer's scale pool: a quantized page's KV is the
+    (data, scale) pair, and copying one without the other would
+    dequantize the replica against a foreign scale. src/dst are traced
     scalars — one executable serves every COW. The read-before-write
     data dependency orders it against any later scatter into `dst`."""
 
-    def copy(pools_k, pools_v, src, dst):
+    def copy(pools_k, pools_v, pools_ks, pools_vs, src, dst):
         pools_k = tuple(pk.at[dst].set(pk[src]) for pk in pools_k)
         pools_v = tuple(pv.at[dst].set(pv[src]) for pv in pools_v)
-        return pools_k, pools_v
+        pools_ks = tuple(ps.at[dst].set(ps[src]) for ps in pools_ks)
+        pools_vs = tuple(ps.at[dst].set(ps[src]) for ps in pools_vs)
+        return pools_k, pools_v, pools_ks, pools_vs
 
-    return jax.jit(copy, donate_argnums=(0, 1))
+    return jax.jit(copy, donate_argnums=(0, 1, 2, 3))
 
 
 class DecodeEngine:
@@ -628,6 +706,18 @@ class DecodeEngine:
       greedy specialization). Greedy token streams stay bitwise;
       sampled slots ride the same round as plain decode rows. 0
       disables.
+    - `kv_dtype` ("bf16" default | "int8", ISSUE 9): page-pool storage
+      dtype. int8 stores K/V as int8 with per-(token, group) fp32
+      scale pools (quantized at write time in the scatter paths,
+      dequantized in-register by the paged kernels / on the gathered
+      view by the XLA twins) — roughly half the pool bytes/token and
+      half the decode kernels' cache traffic, at a measured (bench
+      `extra.quant`) greedy logprob drift. bf16 keeps the bitwise
+      generate_tokens parity contract.
+    - `quantize_weights` (default False): one-shot weight-only int8 of
+      the decode GEMV weights (per-output-channel scales,
+      prepare_decode_params(quantize_int8=True)); decode matvecs read
+      half the weight bytes. Decode-only — the fp tree is untouched.
 
     Pages are reserved UP FRONT at admission for the request's whole
     prompt + tokens_to_generate reach, so a running request can never
@@ -643,10 +733,18 @@ class DecodeEngine:
                  warmup_compile: bool = False,
                  prefix_cache: bool = False,
                  spec_decode_k: int = 0,
+                 kv_dtype: str = "bf16",
+                 quantize_weights: bool = False,
                  termination_id: Optional[int] = None,
                  vocab_size: Optional[int] = None, timers=None):
         assert max_context % page_size == 0, \
             "max_context must be a multiple of page_size"
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' (the model compute dtype — "
+                f"the bitwise-parity default) or 'int8' (quantized "
+                f"pages, docs/GUIDE.md 'Quantized serving'), got "
+                f"{kv_dtype!r}")
         self.model = model
         self.cfg = model.cfg
         self.slots = slots
@@ -679,17 +777,50 @@ class DecodeEngine:
         self._prefix = PrefixCache(page_size) if prefix_cache else None
         assert spec_decode_k >= 0
         self.spec_decode_k = spec_decode_k
+        self.kv_dtype = kv_dtype
+        self.quantize_weights = quantize_weights
         self.termination_id = termination_id
         self.vocab_size = vocab_size
         self.timers = timers
 
-        self._dec_params = (model.prepare_decode_params(params)
-                            if hasattr(model, "prepare_decode_params")
-                            else params)
+        if quantize_weights:
+            if not hasattr(model, "prepare_decode_params"):
+                raise ValueError(
+                    "quantize_weights=True needs the model's "
+                    "prepare_decode_params(quantize_int8=...) decode "
+                    "layout (weight-only int8 is a decode-tree "
+                    "transform)")
+            self._dec_params = model.prepare_decode_params(
+                params, quantize_int8=True)
+        else:
+            self._dec_params = (model.prepare_decode_params(params)
+                                if hasattr(model, "prepare_decode_params")
+                                else params)
         caches = model.init_paged_kv_caches(
-            slots, self.num_pages, page_size, self.max_pages_per_slot)
+            slots, self.num_pages, page_size, self.max_pages_per_slot,
+            kv_dtype=jnp.int8 if kv_dtype == "int8" else None)
         self._pools_k = caches["k_pages_layers"]
         self._pools_v = caches["v_pages_layers"]
+        # int8 engines (ISSUE 9): per-layer fp32 scale pools ride every
+        # jitted step alongside the data pools (donated, updated in
+        # place); fp engines carry empty tuples through the same
+        # signatures — ONE step-fn shape for both modes
+        self._pools_ks = caches.get("k_scales_layers", ())
+        self._pools_vs = caches.get("v_scales_layers", ())
+        if kv_dtype == "int8" and page_size % 32 != 0:
+            # the int8 Pallas gate needs 32-sublane pages: with this
+            # page_size every TPU step silently takes the dequantizing
+            # XLA twin (full fp32 pool materialization per layer per
+            # step) — worse bandwidth than the bf16 path the operator
+            # opted out of. Legitimate off-TPU (the twin IS the CPU
+            # path), so warn loudly instead of refusing.
+            _logger.warning(
+                "kv_dtype=int8 with page_size=%d: the int8 paged "
+                "kernels need page_size %% 32 == 0 — on TPU this "
+                "config serves every step through the dequantizing "
+                "XLA fallback and forfeits the bandwidth win. Use "
+                "page_size 32/64 (docs/GUIDE.md 'Quantized serving')",
+                page_size)
         V = self.cfg.padded_vocab_size
         self._last_logits = jnp.zeros((slots, V), jnp.float32)
         # host-authoritative mirrors (tiny; shipped to device each step)
@@ -952,12 +1083,15 @@ class DecodeEngine:
                     matched = match.matched
                     if match.cow_src is not None:
                         # copy-on-write: the divergent page starts as a
-                        # private replica of the shared page; prefill
-                        # resumes at the divergence offset inside it,
-                        # so the shared page never sees this request's
-                        # writes
-                        self._pools_k, self._pools_v = self._copy_fn(
+                        # private replica of the shared page (data AND
+                        # scale pools — a quantized page is the pair);
+                        # prefill resumes at the divergence offset
+                        # inside it, so the shared page never sees this
+                        # request's writes
+                        (self._pools_k, self._pools_v, self._pools_ks,
+                         self._pools_vs) = self._copy_fn(
                             self._pools_k, self._pools_v,
+                            self._pools_ks, self._pools_vs,
                             jnp.asarray(match.cow_src, jnp.int32),
                             jnp.asarray(pages[match.full_pages],
                                         jnp.int32))
@@ -970,9 +1104,11 @@ class DecodeEngine:
                 self._lengths[si] = matched
             else:
                 plen = bucket_prefill_len(len(req.prompt))
-                self._pools_k, self._pools_v, row_logits, plp = \
+                (self._pools_k, self._pools_v, self._pools_ks,
+                 self._pools_vs, row_logits, plp) = \
                     self._prefill_fn(plen)(
                         self._dec_params, self._pools_k, self._pools_v,
+                        self._pools_ks, self._pools_vs,
                         jnp.asarray(np.asarray(req.prompt[:plen],
                                                np.int32)[None]),
                         jnp.asarray(self._pt[si]),
@@ -1194,9 +1330,11 @@ class DecodeEngine:
             sample_steps[i] = s.sample_step
 
         all_greedy = all(self._slots[i].req.greedy for i in live)
-        (chosen, chosen_lp, new_logits, self._pools_k, self._pools_v) = \
+        (chosen, chosen_lp, new_logits, self._pools_k, self._pools_v,
+         self._pools_ks, self._pools_vs) = \
             self._step_fn(hor, all_greedy)(
                 self._dec_params, self._pools_k, self._pools_v,
+                self._pools_ks, self._pools_vs,
                 jnp.asarray(self._pt), jnp.asarray(self._lengths),
                 self._last_logits, jnp.asarray(active),
                 jnp.asarray(forced), jnp.asarray(use_forced),
@@ -1283,8 +1421,10 @@ class DecodeEngine:
         all_greedy = all(self._slots[i].req.greedy for i in dec)
 
         (first, first_lp, chunk_lps, new_last, self._pools_k,
-         self._pools_v) = self._mixed_fn(width, all_greedy)(
+         self._pools_v, self._pools_ks, self._pools_vs) = \
+            self._mixed_fn(width, all_greedy)(
             self._dec_params, self._pools_k, self._pools_v,
+            self._pools_ks, self._pools_vs,
             jnp.asarray(self._pt), jnp.asarray(self._lengths),
             self._last_logits, jnp.asarray(chunk_tokens),
             jnp.asarray(chunk_lens), jnp.asarray(is_prefill),
@@ -1476,8 +1616,10 @@ class DecodeEngine:
             sample_steps[i] = s.sample_step
         all_greedy = all(self._slots[i].req.greedy for i in live)
         (first, first_lp, gt, gt_lp, acc, new_last, self._pools_k,
-         self._pools_v) = self._spec_fn(width, all_greedy)(
+         self._pools_v, self._pools_ks, self._pools_vs) = \
+            self._spec_fn(width, all_greedy)(
             self._dec_params, self._pools_k, self._pools_v,
+            self._pools_ks, self._pools_vs,
             jnp.asarray(self._pt), jnp.asarray(self._lengths),
             self._last_logits, jnp.asarray(chunk_tokens),
             jnp.asarray(chunk_lens), jnp.asarray(is_spec),
@@ -1597,9 +1739,11 @@ class DecodeEngine:
         zeros_i = np.zeros((n,), np.int32)
         null_pt = jnp.asarray(np.zeros_like(self._pt))
         for h in horizon_buckets(self.step_horizon):
-            (_, _, _, self._pools_k, self._pools_v) = self._step_fn(
+            (_, _, _, self._pools_k, self._pools_v, self._pools_ks,
+             self._pools_vs) = self._step_fn(
                 h, True)(
                 self._dec_params, self._pools_k, self._pools_v,
+                self._pools_ks, self._pools_vs,
                 null_pt, jnp.asarray(zeros_i), self._last_logits,
                 jnp.asarray(np.zeros(n, bool)),
                 jnp.asarray(np.zeros((n, h), np.int32)),
@@ -1613,9 +1757,11 @@ class DecodeEngine:
             )
         if self.prefill_chunk_tokens:
             for w in mixed_width_buckets(self.prefill_chunk_tokens):
-                (_, _, _, _, self._pools_k, self._pools_v) = \
+                (_, _, _, _, self._pools_k, self._pools_v,
+                 self._pools_ks, self._pools_vs) = \
                     self._mixed_fn(w, True)(
                     self._dec_params, self._pools_k, self._pools_v,
+                    self._pools_ks, self._pools_vs,
                     null_pt, jnp.asarray(zeros_i), self._last_logits,
                     jnp.asarray(np.zeros((n, w), np.int32)),
                     jnp.asarray(zeros_i),
@@ -1630,9 +1776,11 @@ class DecodeEngine:
                 )
         if self.spec_decode_k:
             w = self.spec_decode_k + 1
-            (_, _, _, _, _, _, self._pools_k, self._pools_v) = \
+            (_, _, _, _, _, _, self._pools_k, self._pools_v,
+             self._pools_ks, self._pools_vs) = \
                 self._spec_fn(w, True)(
                 self._dec_params, self._pools_k, self._pools_v,
+                self._pools_ks, self._pools_vs,
                 null_pt, jnp.asarray(zeros_i), self._last_logits,
                 jnp.asarray(np.zeros((n, w), np.int32)),
                 jnp.asarray(zeros_i),
@@ -1665,7 +1813,8 @@ class DecodeEngine:
         h = horizon_buckets(self.step_horizon)[-1]
         out = [(
             "engine.decode_scan", self._step_fn(h, True),
-            (self._dec_params, self._pools_k, self._pools_v, null_pt,
+            (self._dec_params, self._pools_k, self._pools_v,
+             self._pools_ks, self._pools_vs, null_pt,
              zeros_i, self._last_logits, zeros_b,
              jnp.asarray(np.zeros((n, h), np.int32)),
              jnp.asarray(np.zeros((n, h), bool)), ones_b, ones_f,
@@ -1674,7 +1823,8 @@ class DecodeEngine:
             w = mixed_width_buckets(self.prefill_chunk_tokens)[-1]
             out.append((
                 "engine.mixed_step", self._mixed_fn(w, True),
-                (self._dec_params, self._pools_k, self._pools_v, null_pt,
+                (self._dec_params, self._pools_k, self._pools_v,
+                 self._pools_ks, self._pools_vs, null_pt,
                  zeros_i, self._last_logits,
                  jnp.asarray(np.zeros((n, w), np.int32)), zeros_i,
                  zeros_b, jnp.asarray(0, jnp.int32), ones_b, ones_f,
@@ -1683,25 +1833,44 @@ class DecodeEngine:
         out.append((
             "engine.prefill_bucket", self._prefill_fn(plen),
             (self._dec_params, self._pools_k, self._pools_v,
+             self._pools_ks, self._pools_vs,
              jnp.asarray(np.zeros((1, plen), np.int32)),
              jnp.asarray(self._pt[0]))))
         if self.spec_decode_k:
             w = self.spec_decode_k + 1
             out.append((
                 "engine.spec_verify", self._spec_fn(w, True),
-                (self._dec_params, self._pools_k, self._pools_v, null_pt,
+                (self._dec_params, self._pools_k, self._pools_v,
+                 self._pools_ks, self._pools_vs, null_pt,
                  zeros_i, self._last_logits,
                  jnp.asarray(np.zeros((n, w), np.int32)), zeros_i,
                  zeros_b, ones_b, ones_f, zeros_i, zeros_f, zeros_u,
                  zeros_i)))
         out.append((
             "engine.page_copy", self._copy_fn,
-            (self._pools_k, self._pools_v, jnp.asarray(0, jnp.int32),
+            (self._pools_k, self._pools_v, self._pools_ks,
+             self._pools_vs, jnp.asarray(0, jnp.int32),
              jnp.asarray(0, jnp.int32))))
         return out
 
     def start(self):
         assert self._thread is None, "engine already started"
+        # startup capacity log (ISSUE 9): the kv_dtype decision and
+        # what it buys, in the operator's units — mirrors the
+        # serve_kv_* gauges on GET /metrics
+        _logger.info(
+            "decode engine: %d slots, paged KV pool kv_dtype=%s — "
+            "%d pages x %d tokens = %d KV positions, %.1f MiB pool "
+            "(%d bytes/token)%s%s",
+            self.slots, self.kv_pool_dtype(), self.num_pages - 1,
+            self.page_size, (self.num_pages - 1) * self.page_size,
+            self.kv_pool_bytes() / 2**20, self.kv_bytes_per_token(),
+            ", weight-only int8 decode matmuls"
+            if self.quantize_weights else "",
+            "" if self.kv_dtype == "bf16" else
+            " [fp default off: greedy parity is measured drift, not "
+            "bitwise — see docs/GUIDE.md 'Quantized serving']",
+        )
         if self.warmup_compile:
             self.warmup()
         self._running = True
@@ -1752,6 +1921,31 @@ class DecodeEngine:
 
     # -- observability -----------------------------------------------------
 
+    def kv_pool_dtype(self) -> str:
+        """The pool's ACTUAL storage dtype (e.g. 'int8', 'bfloat16',
+        'float32') — what the gauges report. kv_dtype='bf16' means
+        'the model compute dtype', so an fp32-compute deployment
+        genuinely stores fp32 pages; reporting the constructor string
+        there would contradict the bytes gauges next to it."""
+        return str(self._pools_k[0].dtype)
+
+    def kv_pool_bytes(self) -> int:
+        """Total HBM the paged KV pool holds — data pools plus (int8)
+        scale pools, summed over layers. Derived from the ACTUAL
+        allocated arrays, so the capacity gauges can never drift from
+        what the engine really pays."""
+        leaves = (*self._pools_k, *self._pools_v,
+                  *self._pools_ks, *self._pools_vs)
+        return int(sum(x.size * x.dtype.itemsize for x in leaves))
+
+    def kv_bytes_per_token(self) -> int:
+        """KV bytes one cached token costs across all layers (K + V
+        data + any scales) — the page-pool sizing number operators
+        compare against HBM (docs/GUIDE.md sizing math: ~96 KiB/token
+        bf16, ~48 KiB/token int8 on the bench model)."""
+        return round(self.kv_pool_bytes()
+                     / (self.num_pages * self.page_size))
+
     @staticmethod
     def _pct(window, p: float) -> float:
         xs = sorted(window)
@@ -1792,6 +1986,15 @@ class DecodeEngine:
             ttft = list(self._ttft_ms)
             decode_ms = list(self._decode_ms)
         out = {
+            # capacity gauges (ISSUE 9): which dtype the pool ACTUALLY
+            # stores (kv_pool_dtype — consistent with the bytes gauges
+            # by construction), what it costs, and what one token
+            # costs — the int8 capacity doubling made visible to
+            # operators (timers.gauge takes numbers or strings;
+            # /metrics serves both)
+            "serve_kv_dtype": self.kv_pool_dtype(),
+            "serve_kv_pool_bytes": self.kv_pool_bytes(),
+            "serve_kv_bytes_per_token": self.kv_bytes_per_token(),
             "serve_slot_occupancy": occupied / self.slots,
             "serve_queue_depth": len(self._queue),
             "serve_pages_in_use": self.num_pages - 1

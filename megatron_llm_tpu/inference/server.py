@@ -538,8 +538,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path.rstrip("/") == "/metrics":
             # live engine counters (DecodeEngine.counters — occupancy,
-            # queue depth, pages, tok/s, and the latency gauges
-            # serve_ttft_p50/p95_ms + serve_decode_p95_ms) as JSON; the
+            # queue depth, pages, tok/s, the latency gauges
+            # serve_ttft_p50/p95_ms + serve_decode_p95_ms, and the
+            # ISSUE-9 capacity gauges serve_kv_dtype /
+            # serve_kv_pool_bytes / serve_kv_bytes_per_token) as JSON; the
             # same dict the timers-gauge export carries, so dashboards
             # and curl read one schema. 404 when no engine is attached
             # (whole-batch-only server has no per-request gauges).

@@ -29,6 +29,10 @@ import jax.numpy as jnp
 
 from megatron_llm_tpu.models.remat import tag as _savepoint
 from megatron_llm_tpu.models.rope import apply_rope
+from megatron_llm_tpu.ops.quantization import (
+    qdot,
+    scatter_quantized_rows,
+)
 from megatron_llm_tpu.parallel.mesh import (
     CONTEXT_AXIS,
     get_context,
@@ -250,7 +254,10 @@ def attention_block(
     b, s, h = hidden.shape
     compute_dtype = cfg.compute_dtype
 
-    mixed = hidden @ attn_params["wqkv"].astype(compute_dtype)
+    # qdot: `hidden @ wqkv.astype(dt)` for fp weights (bitwise the old
+    # call), int8 GEMV + per-channel scale for weight-only quantized
+    # decode trees (prepare_decode_params(quantize_int8=True))
+    mixed = qdot(hidden, attn_params["wqkv"], compute_dtype)
     if "bqkv" in attn_params:
         mixed = mixed + attn_params["bqkv"].astype(compute_dtype)
     # named save point: under remat_policy selective/offload the fused QKV
@@ -288,20 +295,27 @@ def attention_block(
         # use_pallas=True means "kernel if eligible, XLA twin
         # otherwise"; min_cache matches the paged-decode gate so decode
         # rows take the SAME kernel-vs-XLA path in mixed and scan steps
-        ctx, kp, vp = ragged_paged_prefill(
+        quantized = "k_scales" in kv_cache  # int8 pools (ISSUE 9)
+        res = ragged_paged_prefill(
             q, k, v, kv_cache["k_pages"], kv_cache["v_pages"],
             page_table, lengths, chunk_lens,
             use_pallas=cfg.use_decode_attn,
             min_cache=cfg.decode_attn_min_cache,
             interpret=cfg.decode_attn_interpret,
+            k_scales=kv_cache.get("k_scales"),
+            v_scales=kv_cache.get("v_scales"),
         )
-        new_cache = {"k_pages": kp, "v_pages": vp,
-                     "page_table": page_table,
+        new_cache = {"page_table": page_table,
                      "lengths": lengths + chunk_lens,
                      "chunk_lens": chunk_lens}
+        if quantized:
+            (ctx, new_cache["k_pages"], new_cache["v_pages"],
+             new_cache["k_scales"], new_cache["v_scales"]) = res
+        else:
+            ctx, new_cache["k_pages"], new_cache["v_pages"] = res
         ctx = shard_activation(ctx.reshape(b, s, g, qpk * d), "heads") \
             .reshape(b, s, -1)
-        out = ctx @ attn_params["wo"].astype(compute_dtype)
+        out = qdot(ctx, attn_params["wo"], compute_dtype)
         if "bo" in attn_params:
             out = out + attn_params["bo"].astype(compute_dtype)
         return out, new_cache
@@ -325,12 +339,31 @@ def attention_block(
         pages = jnp.take_along_axis(
             page_table, (lengths // ps)[:, None], axis=1)[:, 0]
         offs = lengths % ps
-        kp = kv_cache["k_pages"].at[pages, offs].set(k[:, 0])
-        vp = kv_cache["v_pages"].at[pages, offs].set(v[:, 0])
+        quantized = "k_scales" in kv_cache  # int8 pools (ISSUE 9)
+        ksp = vsp = None
+        if quantized:
+            # quantize-at-write through the ONE shared definition
+            # (ops/quantization.scatter_quantized_rows): the step's
+            # post-RoPE K/V rows become int8 + per-(slot, group) fp32
+            # scales at the same [page, offset] of both pools (retired
+            # slots scribble the null page with both, like the data)
+            kp, ksp = scatter_quantized_rows(
+                kv_cache["k_pages"], kv_cache["k_scales"], pages, offs,
+                k[:, 0])
+            vp, vsp = scatter_quantized_rows(
+                kv_cache["v_pages"], kv_cache["v_scales"], pages, offs,
+                v[:, 0])
+        else:
+            kp = kv_cache["k_pages"].at[pages, offs].set(k[:, 0])
+            vp = kv_cache["v_pages"].at[pages, offs].set(v[:, 0])
         new_cache = {"k_pages": kp, "v_pages": vp,
                      "page_table": page_table, "lengths": lengths + 1}
+        if quantized:
+            new_cache["k_scales"] = ksp
+            new_cache["v_scales"] = vsp
         from megatron_llm_tpu.ops.decode_attention import (
             _xla_paged_decode,
+            _xla_paged_decode_quant,
             paged_decode_attention,
             paged_decode_attn_block,
         )
@@ -340,13 +373,20 @@ def attention_block(
             bt = paged_decode_attn_block(
                 s, qpk, d, ps, page_table.shape[1],
                 min_cache=cfg.decode_attn_min_cache,
+                kv_dtype=kp.dtype,
                 interpret=cfg.decode_attn_interpret,
             )
         if bt is not None:
             ctx = paged_decode_attention(
                 q, kp, vp, page_table, lengths + 1, use_pallas=True,
                 interpret=cfg.decode_attn_interpret,
+                k_scales=ksp, v_scales=vsp,
             )
+        elif quantized:
+            # the quantize-then-dequantize twin of the int8 kernel —
+            # the CPU oracle AND the off-TPU serving path
+            ctx = _xla_paged_decode_quant(q, kp, vp, ksp, vsp,
+                                          page_table, lengths + 1)
         else:
             # the paged kernel's shapes-and-math twin (gather pages to
             # the dense view + the _xla_decode op sequence) — ONE shared
@@ -354,7 +394,7 @@ def attention_block(
             ctx = _xla_paged_decode(q, kp, vp, page_table, lengths + 1)
         ctx = shard_activation(ctx.reshape(b, s, g, qpk * d), "heads") \
             .reshape(b, s, -1)
-        out = ctx @ attn_params["wo"].astype(compute_dtype)
+        out = qdot(ctx, attn_params["wo"], compute_dtype)
         if "bo" in attn_params:
             out = out + attn_params["bo"].astype(compute_dtype)
         return out, new_cache
@@ -406,7 +446,7 @@ def attention_block(
                 ctx = _xla_decode(q, kc, vc, offset + s, "gtd")
             ctx = shard_activation(ctx.reshape(b, s, g, qpk * d), "heads") \
                 .reshape(b, s, -1)
-            out = ctx @ attn_params["wo"].astype(compute_dtype)
+            out = qdot(ctx, attn_params["wo"], compute_dtype)
             if "bo" in attn_params:
                 out = out + attn_params["bo"].astype(compute_dtype)
             return out, new_cache
@@ -547,7 +587,7 @@ def attention_block(
         ctx.reshape(b, s, cfg.num_query_groups, cfg.q_per_kv * cfg.head_dim),
         "heads",
     ).reshape(b, s, -1)
-    out = ctx @ attn_params["wo"].astype(compute_dtype)
+    out = qdot(ctx, attn_params["wo"], compute_dtype)
     if "bo" in attn_params:
         out = out + attn_params["bo"].astype(compute_dtype)
     out = _savepoint(out, "attn_dense")

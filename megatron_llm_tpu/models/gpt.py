@@ -75,7 +75,8 @@ class GPTModel:
         loss_mask = loss_mask.astype(jnp.float32)
         return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
 
-    def prepare_decode_params(self, params: dict) -> dict:
+    def prepare_decode_params(self, params: dict,
+                              quantize_int8: bool = False) -> dict:
         """Decode-layout view of the params, built ONCE before the token
         loop (called inside generate's jit, ahead of the while_loop):
 
@@ -88,7 +89,15 @@ class GPTModel:
           traffic). transformer_stack unrolls over the tuple;
         - the GLU up/gate weight (h, 2, f) is flattened to (h, 2f) (a
           row-major bitcast): the 2-sized axis otherwise tiles into
-          sublanes and the matvec streams at ~33% of HBM bandwidth.
+          sublanes and the matvec streams at ~33% of HBM bandwidth;
+        - `quantize_int8=True` (ISSUE 9, decode-only — the fp tree is
+          untouched and stays the default): the four big per-layer GEMV
+          weights (wqkv, wo, w1, w2) are one-shot quantized to
+          weight-only int8 with per-output-channel fp32 scales
+          (ops/quantization.quantize_decode_layers); the decode matvecs
+          read half the weight bytes. Biases/norms/embeddings/head stay
+          fp — see the accuracy contract in docs/GUIDE.md ("Quantized
+          serving").
         """
         import jax
 
@@ -107,6 +116,12 @@ class GPTModel:
 
         params = dict(params)
         params["layers"] = tuple(layer_slice(i) for i in range(L))
+        if quantize_int8:
+            from megatron_llm_tpu.ops.quantization import (
+                quantize_decode_layers,
+            )
+
+            params["layers"] = quantize_decode_layers(params["layers"])
         return params
 
     def init_kv_caches(self, batch_size: int, max_len: int,
@@ -149,7 +164,8 @@ class GPTModel:
 
     def init_paged_kv_caches(self, slots: int, num_pages: int,
                              page_size: int,
-                             max_pages_per_slot: int) -> dict:
+                             max_pages_per_slot: int,
+                             kv_dtype=None) -> dict:
         """Paged KV cache for the continuous-batching engine
         (inference/engine.py): per-layer GLOBAL page pools
         (num_pages, page_size, g, d) shared by all slots, one
@@ -161,15 +177,33 @@ class GPTModel:
         HBM cost per layer: 2 * num_pages * page_size * g * d *
         itemsize; unlike the dense layouts above it is independent of
         slots * max_len, which is the whole point (docs/GUIDE.md,
-        "Continuous-batching serving engine")."""
+        "Continuous-batching serving engine").
+
+        `kv_dtype` (default: cfg.compute_dtype) picks the pool storage
+        dtype. int8 (ISSUE 9) additionally allocates per-layer fp32
+        scale pools `k/v_scales_layers` of (num_pages, page_size, g) —
+        one symmetric scale per (token, group), written by the same
+        scatter paths that write the data and consumed in-register by
+        the paged kernels — roughly halving the pool's bytes/token
+        (docs/GUIDE.md, "Quantized serving")."""
         cfg = self.cfg
+        kv_dtype = cfg.compute_dtype if kv_dtype is None else kv_dtype
         shape = (num_pages, page_size, cfg.num_query_groups, cfg.head_dim)
-        return {
-            "k_pages_layers": tuple(jnp.zeros(shape, cfg.compute_dtype)
+        caches = {
+            "k_pages_layers": tuple(jnp.zeros(shape, kv_dtype)
                                     for _ in range(cfg.num_layers)),
-            "v_pages_layers": tuple(jnp.zeros(shape, cfg.compute_dtype)
+            "v_pages_layers": tuple(jnp.zeros(shape, kv_dtype)
                                     for _ in range(cfg.num_layers)),
             "page_table": jnp.zeros((slots, max_pages_per_slot),
                                     jnp.int32),
             "lengths": jnp.zeros((slots,), jnp.int32),
         }
+        if jnp.dtype(kv_dtype) == jnp.int8:
+            sshape = shape[:-1]
+            caches["k_scales_layers"] = tuple(
+                jnp.zeros(sshape, jnp.float32)
+                for _ in range(cfg.num_layers))
+            caches["v_scales_layers"] = tuple(
+                jnp.zeros(sshape, jnp.float32)
+                for _ in range(cfg.num_layers))
+        return caches

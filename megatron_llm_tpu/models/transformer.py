@@ -28,6 +28,7 @@ from megatron_llm_tpu.models.activations import ACTIVATIONS, GLU_ACTIVATIONS
 from megatron_llm_tpu.models.attention import attention_block
 from megatron_llm_tpu.models.norms import apply_norm
 from megatron_llm_tpu.models.remat import remat_wrap, tag as _savepoint
+from megatron_llm_tpu.ops.quantization import is_quantized_weight, qdot
 from megatron_llm_tpu.parallel.mesh import shard_activation
 
 
@@ -112,11 +113,17 @@ def init_layer_params(cfg, key, num_layers: Optional[int] = None) -> dict:
 
 
 def mlp_block(mlp_params, cfg, hidden, dropout_rng, deterministic):
-    """ParallelMLP (ref: transformer.py:77-142): h -> [2x]ffn -> act -> h."""
+    """ParallelMLP (ref: transformer.py:77-142): h -> [2x]ffn -> act -> h.
+
+    Weight-only int8 decode trees (prepare_decode_params
+    (quantize_int8=True), ISSUE 9) arrive with w1/w2 as
+    {"int8_data", "scale"} dicts — always in the pre-flattened 2D
+    decode layout — and route through `qdot` (int8 GEMV + per-channel
+    scale); fp weights take the bitwise-unchanged matmuls."""
     dt = cfg.compute_dtype
-    w1 = mlp_params["w1"].astype(dt)
+    w1 = mlp_params["w1"]
     if cfg.glu_activation:
-        if w1.ndim == 2:
+        if is_quantized_weight(w1) or w1.ndim == 2:
             # Pre-flattened (h, 2f) decode layout (see
             # prepare_decode_params): the (h, 2, f) einsum tiles the
             # 2-sized gate/up axis into sublanes and streams the weight
@@ -124,10 +131,10 @@ def mlp_block(mlp_params, cfg, hidden, dropout_rng, deterministic):
             # v5e); the SAME bytes as one flat matvec stream at ~72%
             # like every other GEMV.
             b, s, h = hidden.shape
-            x = (hidden @ w1).reshape(b, s, 2, -1)
+            x = qdot(hidden, w1, dt).reshape(b, s, 2, -1)
         else:
             # (b,s,h) @ (h,2,f) -> (b,s,2,f); gate/up on their own axis.
-            x = jnp.einsum("bsh,hcf->bscf", hidden, w1)
+            x = jnp.einsum("bsh,hcf->bscf", hidden, w1.astype(dt))
         if "b1" in mlp_params:
             x = x + mlp_params["b1"].astype(dt)
         # named save point: the pre-GLU up-projection — what the selective
@@ -138,13 +145,13 @@ def mlp_block(mlp_params, cfg, hidden, dropout_rng, deterministic):
         act = GLU_ACTIVATIONS[cfg.glu_activation]
         x = act(x[..., 0, :], x[..., 1, :])
     else:
-        x = hidden @ w1
+        x = qdot(hidden, w1, dt)
         if "b1" in mlp_params:
             x = x + mlp_params["b1"].astype(dt)
         x = _savepoint(x, "mlp_pre_act")
         x = ACTIVATIONS[cfg.hidden_act](x)
     x = shard_activation(x, "ffn")
-    x = x @ mlp_params["w2"].astype(dt)
+    x = qdot(x, mlp_params["w2"], dt)
     if "b2" in mlp_params:
         x = x + mlp_params["b2"].astype(dt)
     return _savepoint(x, "mlp_out")
@@ -307,15 +314,26 @@ def transformer_stack(
             cl = kv_caches.get("chunk_lens")
             ks = list(kv_caches["k_pages_layers"])
             vs = list(kv_caches["v_pages_layers"])
+            # int8 KV pools (ISSUE 9): per-layer fp32 scale pools ride
+            # alongside the data pools through every layer
+            kss = (list(kv_caches["k_scales_layers"])
+                   if "k_scales_layers" in kv_caches else None)
+            vss = (list(kv_caches["v_scales_layers"])
+                   if kss is not None else None)
             for i in range(L):
                 cache_l = {"k_pages": ks[i], "v_pages": vs[i],
                            "page_table": pt, "lengths": lens}
                 if cl is not None:
                     cache_l["chunk_lens"] = cl
+                if kss is not None:
+                    cache_l["k_scales"] = kss[i]
+                    cache_l["v_scales"] = vss[i]
                 (hidden,), nc = body(
                     (hidden,), (layer_params[i], idxs[i], cache_l)
                 )
                 ks[i], vs[i] = nc["k_pages"], nc["v_pages"]
+                if kss is not None:
+                    kss[i], vss[i] = nc["k_scales"], nc["v_scales"]
             new_caches = {
                 "k_pages_layers": tuple(ks), "v_pages_layers": tuple(vs),
                 "page_table": pt,
@@ -324,6 +342,9 @@ def transformer_stack(
             }
             if cl is not None:
                 new_caches["chunk_lens"] = cl
+            if kss is not None:
+                new_caches["k_scales_layers"] = tuple(kss)
+                new_caches["v_scales_layers"] = tuple(vss)
             return hidden, new_caches
         offset = kv_caches["offset"]
         ks = list(kv_caches["k_layers"])

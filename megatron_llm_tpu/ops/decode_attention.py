@@ -50,6 +50,17 @@ at it and retired/inactive slots park there, so clamped DMAs always
 have a real page to read. `_xla_paged_decode` (gather pages to the
 dense "tgd" view, then the `_xla_decode` math) is the numerically
 matching fallback and the CPU test oracle.
+
+INT8 KV PAGES (ISSUE 9 tentpole): the paged variant also serves int8
+pools — K/V stored int8 with per-(token, group) fp32 scales in parallel
+(num_pages, page_size, g) scale pools (ops/quantization.py is the ONE
+rounding/scale convention). The kernel DMAs the scale column with its
+page through the same clamped index map and dequantizes in-register
+before the unchanged fp32 online-softmax math; `_xla_paged_decode_quant`
+(dequantize pools -> the fp twin) is the quantize-then-dequantize
+oracle and the off-TPU serving path. Halves the decode kernel's HBM
+cache traffic; quantization itself happens at write time in the
+engine's scatter paths, never here.
 """
 
 from __future__ import annotations
@@ -116,14 +127,22 @@ def decode_attn_block(s: int, qpk: int, d: int, T: int, *,
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                   acc_scr, *, block_t, rows, qpk, d, num_t_blocks,
-                   sm_scale, s, split_boundary=True, batched_len=False):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_t, rows,
+                   qpk, d, num_t_blocks, sm_scale, s, split_boundary=True,
+                   batched_len=False, quantized=False):
     """Grid (b, g, num_t_blocks); the t dim carries the online-softmax
     state in VMEM scratch. Row r of the folded (rows, d) q block is query
     position offset + r // qpk (head fastest), offset = length - s.
     `batched_len` reads a PER-ROW length (the paged engine's ragged
-    slots) instead of the dense path's one shared scalar."""
+    slots) instead of the dense path's one shared scalar. `quantized`
+    (the int8-KV paged variant, ISSUE 9): k/v blocks arrive int8 with
+    per-(token, group) fp32 scale columns as two extra (block_t, 1)
+    operands, dequantized in-register before the same fp32 QK/PV math —
+    the softmax/accumulation scheme is byte-identical to the fp path."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     j = pl.program_id(2)
     length = len_ref[pl.program_id(0)] if batched_len else len_ref[0]
     offset = length - s
@@ -139,9 +158,14 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         # MXU precision costs nothing; scores live in the exp2 domain
         # (sm_scale folded with log2(e), flash kernel convention)
         qb = q_ref[:].reshape(rows, d)
-        kb = k_ref[:].reshape(block_t, d)
+        kb = k_ref[:].reshape(block_t, d).astype(jnp.float32)
+        if quantized:
+            # dequantize in-register: one fp32 multiply per cache
+            # element against the page's (block_t, 1) scale column —
+            # HBM saw only the int8 bytes
+            kb = kb * ks_ref[:].reshape(block_t, 1)
         sc = jax.lax.dot_general(
-            qb.astype(jnp.float32), kb.astype(jnp.float32),
+            qb.astype(jnp.float32), kb,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * (sm_scale * LOG2E)
@@ -162,9 +186,14 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         alpha = jnp.exp2(m_prev - m_new)
         p = jnp.exp2(sc - m_new)  # (rows, block_t)
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            vb = v_ref[:].reshape(block_t, d).astype(jnp.float32) \
+                * vs_ref[:].reshape(block_t, 1)
+        else:
+            vb = v_ref[:].reshape(block_t, d)
+            p = p.astype(v_ref.dtype)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p.astype(v_ref.dtype), v_ref[:].reshape(block_t, d),
-            preferred_element_type=jnp.float32,
+            p, vb, preferred_element_type=jnp.float32,
         )
         m_scr[:] = m_new
 
@@ -333,6 +362,7 @@ def decode_attention(
 def paged_decode_attn_block(s: int, qpk: int, d: int, page_size: int,
                             num_slot_pages: int, *,
                             min_cache: int = 0,
+                            kv_dtype=None,
                             interpret: bool = False) -> Optional[int]:
     """Static dispatch check for the paged kernel: returns the block size
     (== page_size; the page IS the DMA unit) or None for the XLA path.
@@ -340,30 +370,38 @@ def paged_decode_attn_block(s: int, qpk: int, d: int, page_size: int,
     Same territory as `decode_attn_block` — single-token steps,
     lane-aligned head dim, a big-enough cache — with the block constraint
     moved onto the page: `page_size` must tile sublanes (multiple of 16
-    covers bf16), and the per-slot reach num_slot_pages * page_size
-    stands in for the allocated T of the dense gate.
+    covers bf16; int8 pools need 32, the int8 sublane tile), and the
+    per-slot reach num_slot_pages * page_size stands in for the
+    allocated T of the dense gate.
     """
     if not (interpret or jax.default_backend() == "tpu"):
         return None
     if s != 1 or s * qpk > MAX_DECODE_ROWS or d % 128 != 0:
         return None
-    if page_size < 16 or page_size % 16 != 0:
+    is_int8 = kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8
+    sublane = 32 if is_int8 else 16
+    if page_size < sublane or page_size % sublane != 0:
         return None
     if num_slot_pages * page_size < max(min_cache, 16):
         return None
     return page_size
 
 
-def _paged_pallas(q, k_pages, v_pages, page_table, lengths, interpret):
+def _paged_pallas(q, k_pages, v_pages, page_table, lengths, interpret,
+                  k_scales=None, v_scales=None):
     """q: (slots, 1, g, qpk, d); k/v_pages: (num_pages, page_size, g, d);
     page_table: (slots, max_pages) int32 pool indices; lengths: (slots,)
     int32 valid positions per slot (0 = empty slot -> zero output).
-    Returns (slots, 1, g, qpk, d) in q's dtype."""
+    k/v_scales (int8 pools only): (num_pages, page_size, g) fp32
+    per-(token, group) scales, DMA'd page-by-page alongside the data
+    through the same clamped index map. Returns (slots, 1, g, qpk, d)
+    in q's dtype."""
     b, s, g, qpk, d = q.shape
     assert s == 1, "paged decode is single-token by construction"
     page_size = k_pages.shape[1]
     max_pages = page_table.shape[1]
     rows = qpk
+    quantized = k_scales is not None
 
     qf = q.transpose(0, 2, 1, 3, 4).reshape(b, g, rows, d)
     # same Mosaic small-memref workaround as the dense launcher: rows
@@ -375,6 +413,7 @@ def _paged_pallas(q, k_pages, v_pages, page_table, lengths, interpret):
         _decode_kernel, block_t=page_size, rows=rows, qpk=qpk, d=d,
         num_t_blocks=max_pages, sm_scale=1.0 / (d ** 0.5), s=1,
         split_boundary=not interpret, batched_len=True,
+        quantized=quantized,
     )
 
     def kernel(len_ref, pt_ref, *rest):
@@ -399,10 +438,23 @@ def _paged_pallas(q, k_pages, v_pages, page_table, lengths, interpret):
             page_index(ib, j, len_ref, pt_ref), 0, ig, 0
         ),
     )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qf, k_pages, v_pages]
+    if quantized:
+        # the (page_size, 1) scale column of this (page, group): rides
+        # the SAME clamped page index map as the data it scales
+        scale_spec = pl.BlockSpec(
+            (None, page_size, 1),
+            lambda ib, ig, j, len_ref, pt_ref: (
+                page_index(ib, j, len_ref, pt_ref), 0, ig
+            ),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, g, max_pages),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[
             pltpu.VMEM((rows, 1), jnp.float32),
@@ -420,7 +472,7 @@ def _paged_pallas(q, k_pages, v_pages, page_table, lengths, interpret):
         ),
         interpret=interpret,
     )(jnp.asarray(lengths, jnp.int32), jnp.asarray(page_table, jnp.int32),
-      qf, k_pages, v_pages)
+      *operands)
     return out.reshape(b, g, 1, qpk, d).transpose(0, 2, 1, 3, 4) \
         .astype(q.dtype)
 
@@ -458,28 +510,55 @@ def _xla_paged_decode(q, k_pages, v_pages, page_table, lengths):
     return out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4)
 
 
+def _xla_paged_decode_quant(q, k_pages, v_pages, k_scales, v_scales,
+                            page_table, lengths):
+    """Quantize-then-dequantize oracle for the int8 paged kernel:
+    dequantize the int8 pools against their per-(token, group) scale
+    pools to the fp32 view, then the exact `_xla_paged_decode` op
+    sequence — what the in-register dequantization inside the kernel
+    must reproduce (same fp32 values entering the same math). Off-TPU
+    this IS the serving path (the engine's CPU fallback), so the oracle
+    and the fallback can never drift."""
+    kf = k_pages.astype(jnp.float32) * k_scales[..., None]
+    vf = v_pages.astype(jnp.float32) * v_scales[..., None]
+    return _xla_paged_decode(q, kf, vf, page_table, lengths)
+
+
 def paged_decode_attention(
     q: jnp.ndarray,  # (slots, 1, g, qpk, d)
-    k_pages: jnp.ndarray,  # (num_pages, page_size, g, d)
+    k_pages: jnp.ndarray,  # (num_pages, page_size, g, d); int8 OK
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,  # (slots, max_pages) int32 pool indices
     lengths: jnp.ndarray,  # (slots,) int32 valid positions incl. this step
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
+    k_scales: Optional[jnp.ndarray] = None,  # (num_pages, page_size, g)
+    v_scales: Optional[jnp.ndarray] = None,  # fp32; required for int8
 ) -> jnp.ndarray:
     """Ragged paged decode attention: slot i attends its query token to
     cache positions 0..lengths[i]-1, streamed page-by-page from the pool
     through its page-table row. Positions past lengths[i] are masked
-    in-kernel; a slot with lengths[i] == 0 returns zeros."""
+    in-kernel; a slot with lengths[i] == 0 returns zeros. Int8 pools
+    (ISSUE 9) carry per-(token, group) fp32 scale pools and dequantize
+    in-register (kernel) or on the gathered view (XLA twin)."""
+    quantized = k_pages.dtype == jnp.int8
+    if quantized:
+        assert k_scales is not None and v_scales is not None, \
+            "int8 KV pools require k_scales/v_scales"
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         b, s, g, qpk, d = q.shape
         bt = paged_decode_attn_block(
             s, qpk, d, k_pages.shape[1], page_table.shape[1],
+            kv_dtype=k_pages.dtype,
             interpret=interpret,
         )
         if bt is not None:
             return _paged_pallas(q, k_pages, v_pages, page_table, lengths,
-                                 interpret)
+                                 interpret, k_scales=k_scales,
+                                 v_scales=v_scales)
+    if quantized:
+        return _xla_paged_decode_quant(q, k_pages, v_pages, k_scales,
+                                       v_scales, page_table, lengths)
     return _xla_paged_decode(q, k_pages, v_pages, page_table, lengths)

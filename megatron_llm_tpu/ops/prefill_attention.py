@@ -78,6 +78,7 @@ def _choose_block_q(C: int, qpk: int) -> Optional[int]:
 def ragged_prefill_block(s: int, qpk: int, d: int, page_size: int,
                          num_slot_pages: int, *,
                          min_cache: int = 0,
+                         kv_dtype=None,
                          interpret: bool = False) -> Optional[int]:
     """Static dispatch check for the ragged prefill kernel: returns the
     q block size (tokens per grid program) or None for the XLA path.
@@ -94,7 +95,12 @@ def ragged_prefill_block(s: int, qpk: int, d: int, page_size: int,
         return None
     if s < 1 or d % 128 != 0:
         return None
-    if page_size < 16 or page_size % 16 != 0:
+    # int8 pools need the int8 sublane tile (32); bf16/fp gets by on 16
+    # — same rule as the paged decode gate, so decode rows keep taking
+    # the same kernel-vs-XLA path in mixed and scan steps
+    is_int8 = kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8
+    sublane = 32 if is_int8 else 16
+    if page_size < sublane or page_size % sublane != 0:
         return None
     if num_slot_pages * page_size < max(min_cache, 16):
         return None
@@ -107,12 +113,19 @@ def ragged_prefill_block(s: int, qpk: int, d: int, page_size: int,
 
 
 def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
-                    o_ref, m_scr, l_scr, acc_scr, *, block_q, page_size,
-                    qpk, d, num_pages, sm_scale, split_boundary=True):
+                    *rest, block_q, page_size, qpk, d, num_pages,
+                    sm_scale, split_boundary=True, quantized=False):
     """Grid (chunk, group, q_block, page); the page dim carries the
     online-softmax state. Row r of the folded (block_q*qpk, d) q block
     is chunk token i*block_q + r // qpk (head fastest) at global
-    position starts[c] + token; rows at tokens >= lens[c] are pad."""
+    position starts[c] + token; rows at tokens >= lens[c] are pad.
+    `quantized` (int8 KV pages, ISSUE 9): k/v arrive int8 with
+    per-(token, group) fp32 scale columns as two extra (page_size, 1)
+    operands, dequantized in-register before the unchanged fp32 math."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     c = pl.program_id(0)
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -128,9 +141,13 @@ def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
 
     def _accum(masked):
         qb = q_ref[:].reshape(rows, d)
-        kb = k_ref[:].reshape(page_size, d)
+        kb = k_ref[:].reshape(page_size, d).astype(jnp.float32)
+        if quantized:
+            # dequantize in-register against the page's (page_size, 1)
+            # scale column — HBM saw only the int8 bytes
+            kb = kb * ks_ref[:].reshape(page_size, 1)
         sc = jax.lax.dot_general(
-            qb.astype(jnp.float32), kb.astype(jnp.float32),
+            qb.astype(jnp.float32), kb,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * (sm_scale * LOG2E)
@@ -159,9 +176,14 @@ def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
         alpha = jnp.exp2(m_prev - m_new)
         p = jnp.exp2(sc - m_new)
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            vb = v_ref[:].reshape(page_size, d).astype(jnp.float32) \
+                * vs_ref[:].reshape(page_size, 1)
+        else:
+            vb = v_ref[:].reshape(page_size, d)
+            p = p.astype(v_ref.dtype)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p.astype(v_ref.dtype), v_ref[:].reshape(page_size, d),
-            preferred_element_type=jnp.float32,
+            p, vb, preferred_element_type=jnp.float32,
         )
         m_scr[:] = m_new
 
@@ -201,15 +223,18 @@ def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
 
 
 def _prefill_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
-                    block_q, interpret):
+                    block_q, interpret, k_scales=None, v_scales=None):
     """q: (nc, C, g, qpk, d); k/v_pages: (P, page_size, g, d);
     page_table: (nc, max_pages) int32; starts/chunk_lens: (nc,) int32.
-    Returns (nc, C, g, qpk, d) in q's dtype (pad rows exact zero)."""
+    k/v_scales (int8 pools only): (P, page_size, g) fp32 per-(token,
+    group) scales riding the same clamped page index map. Returns
+    (nc, C, g, qpk, d) in q's dtype (pad rows exact zero)."""
     nc, C, g, qpk, d = q.shape
     page_size = k_pages.shape[1]
     max_pages = page_table.shape[1]
     rows = block_q * qpk
     num_q_blocks = C // block_q
+    quantized = k_scales is not None
 
     qf = q.transpose(0, 2, 1, 3, 4).reshape(nc, g, C * qpk, d)
     # rows below one fp32 sublane tile: launch q/o in fp32 (the small-
@@ -220,7 +245,7 @@ def _prefill_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
     kernel = functools.partial(
         _prefill_kernel, block_q=block_q, page_size=page_size, qpk=qpk,
         d=d, num_pages=max_pages, sm_scale=1.0 / (d ** 0.5),
-        split_boundary=not interpret,
+        split_boundary=not interpret, quantized=quantized,
     )
 
     def page_index(c, i, j, starts_ref, lens_ref, pt_ref):
@@ -245,10 +270,21 @@ def _prefill_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
             page_index(c, i, j, s_ref, l_ref, pt_ref), 0, gi, 0
         ),
     )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qf, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (None, page_size, 1),
+            lambda c, gi, i, j, s_ref, l_ref, pt_ref: (
+                page_index(c, i, j, s_ref, l_ref, pt_ref), 0, gi
+            ),
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(nc, g, num_q_blocks, max_pages),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[
             pltpu.VMEM((rows, 1), jnp.float32),
@@ -269,7 +305,7 @@ def _prefill_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
         ),
         interpret=interpret,
     )(jnp.asarray(starts, jnp.int32), jnp.asarray(chunk_lens, jnp.int32),
-      jnp.asarray(page_table, jnp.int32), qf, k_pages, v_pages)
+      jnp.asarray(page_table, jnp.int32), *operands)
     return out.reshape(nc, g, C, qpk, d).transpose(0, 2, 1, 3, 4) \
         .astype(q.dtype)
 
@@ -313,32 +349,65 @@ def _xla_ragged_prefill(q, k_pages, v_pages, page_table, starts,
 
 
 def scatter_chunk_kv(k_new, v_new, k_pages, v_pages, page_table, starts,
-                     chunk_lens):
+                     chunk_lens, k_scales=None, v_scales=None):
     """Write a chunk's K/V rows into its slot's pages: token t (valid,
     t < chunk_lens) lands in pool page page_table[c, (starts+t) //
     page_size] at offset (starts+t) % page_size. Pad rows are routed to
     pool page 0 — the dead null page every table parks unowned entries
     on — so they can never touch a live slot's cache. Returns the
-    updated pools."""
+    updated pools.
+
+    Int8 pools (k_pages.dtype == int8; pass the matching k/v_scales
+    pools): this IS the quantize-at-write point — k_new/v_new arrive fp,
+    each (token, group) row quantizes symmetrically over the head dim
+    (ops/quantization.quantize_rows), the int8 data lands in the data
+    pools and the fp32 scales land at the SAME [page, offset] of the
+    scale pools (pad-row scales go to the null page with their data).
+    Returns (k_pages, v_pages, k_scales, v_scales)."""
     nc, C = k_new.shape[:2]
     page_size = k_pages.shape[1]
     max_pages = page_table.shape[1]
+    quantized = k_pages.dtype == jnp.int8
     pos = starts[:, None] + jnp.arange(C)[None, :]  # (nc, C)
     valid = jnp.arange(C)[None, :] < chunk_lens[:, None]
     logical = jnp.clip(pos // page_size, 0, max_pages - 1)
     pages = jnp.where(
         valid, jnp.take_along_axis(page_table, logical, axis=1), 0)
     offs = pos % page_size
-    k_pages = k_pages.at[pages, offs].set(k_new)
-    v_pages = v_pages.at[pages, offs].set(v_new)
+    if quantized:
+        from megatron_llm_tpu.ops.quantization import (
+            scatter_quantized_rows,
+        )
+
+        assert k_scales is not None and v_scales is not None, \
+            "int8 KV pools require k_scales/v_scales"
+        k_pages, k_scales = scatter_quantized_rows(
+            k_pages, k_scales, pages, offs, k_new)
+        v_pages, v_scales = scatter_quantized_rows(
+            v_pages, v_scales, pages, offs, v_new)
+        return k_pages, v_pages, k_scales, v_scales
+    k_pages = k_pages.at[pages, offs].set(k_new.astype(k_pages.dtype))
+    v_pages = v_pages.at[pages, offs].set(v_new.astype(v_pages.dtype))
     return k_pages, v_pages
+
+
+def _xla_ragged_prefill_quant(q, k_pages, v_pages, k_scales, v_scales,
+                              page_table, starts, chunk_lens):
+    """Quantize-then-dequantize oracle for the int8 ragged prefill
+    kernel: dequantize the int8 pools against their per-(token, group)
+    scale pools to the fp32 view, then the exact `_xla_ragged_prefill`
+    op sequence. Off-TPU this IS the engine's mixed-step serving path,
+    so the oracle and the fallback can never drift."""
+    kf = k_pages.astype(jnp.float32) * k_scales[..., None]
+    vf = v_pages.astype(jnp.float32) * v_scales[..., None]
+    return _xla_ragged_prefill(q, kf, vf, page_table, starts, chunk_lens)
 
 
 def ragged_paged_prefill(
     q: jnp.ndarray,  # (nc, C, g, qpk, d) — C = padded chunk width
     k_new: jnp.ndarray,  # (nc, C, g, d) — this chunk's K (RoPE applied)
     v_new: jnp.ndarray,  # (nc, C, g, d)
-    k_pages: jnp.ndarray,  # (num_pages, page_size, g, d)
+    k_pages: jnp.ndarray,  # (num_pages, page_size, g, d); int8 OK
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,  # (nc, max_pages) int32 pool indices
     starts: jnp.ndarray,  # (nc,) int32 — chunk start offset in the slot
@@ -346,6 +415,8 @@ def ragged_paged_prefill(
     use_pallas: Optional[bool] = None,
     min_cache: int = 0,
     interpret: bool = False,
+    k_scales: Optional[jnp.ndarray] = None,  # (num_pages, page_size, g)
+    v_scales: Optional[jnp.ndarray] = None,  # fp32; required for int8
 ):
     """Ragged paged prefill, one pass: scatter the chunk's own K/V into
     its slot's pages, then causal attention of chunk token t (global
@@ -353,21 +424,42 @@ def ragged_paged_prefill(
     the Pallas kernel on TPU (or under the interpreter) and by the
     gather-pages twin elsewhere. A decode row is the chunk_lens == 1
     special case. Returns (out (nc, C, g, qpk, d), k_pages, v_pages);
-    pad rows (t >= chunk_lens) are exact zeros."""
+    pad rows (t >= chunk_lens) are exact zeros.
+
+    Int8 pools (ISSUE 9): pass the fp32 scale pools too — the scatter
+    quantizes the chunk's fp K/V at write time, attention dequantizes
+    in-register (kernel) or on the gathered view (XLA twin), and the
+    return grows to (out, k_pages, v_pages, k_scales, v_scales)."""
     nc, C, g, qpk, d = q.shape
-    k_pages, v_pages = scatter_chunk_kv(
-        k_new, v_new, k_pages, v_pages, page_table, starts, chunk_lens)
+    quantized = k_pages.dtype == jnp.int8
+    if quantized:
+        k_pages, v_pages, k_scales, v_scales = scatter_chunk_kv(
+            k_new, v_new, k_pages, v_pages, page_table, starts,
+            chunk_lens, k_scales=k_scales, v_scales=v_scales)
+    else:
+        k_pages, v_pages = scatter_chunk_kv(
+            k_new, v_new, k_pages, v_pages, page_table, starts,
+            chunk_lens)
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         bq = ragged_prefill_block(C, qpk, d, k_pages.shape[1],
                                   page_table.shape[1],
                                   min_cache=min_cache,
+                                  kv_dtype=k_pages.dtype,
                                   interpret=interpret)
         if bq is not None:
             out = _prefill_pallas(q, k_pages, v_pages, page_table,
-                                  starts, chunk_lens, bq, interpret)
+                                  starts, chunk_lens, bq, interpret,
+                                  k_scales=k_scales, v_scales=v_scales)
+            if quantized:
+                return out, k_pages, v_pages, k_scales, v_scales
             return out, k_pages, v_pages
+    if quantized:
+        out = _xla_ragged_prefill_quant(q, k_pages, v_pages, k_scales,
+                                        v_scales, page_table, starts,
+                                        chunk_lens)
+        return out, k_pages, v_pages, k_scales, v_scales
     out = _xla_ragged_prefill(q, k_pages, v_pages, page_table, starts,
                               chunk_lens)
     return out, k_pages, v_pages

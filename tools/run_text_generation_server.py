@@ -86,6 +86,19 @@ def main():
                         "true} PUTs (one data: event per generated "
                         "token); --no_stream turns the surface off "
                         "(e.g. behind a buffering proxy)")
+    # ISSUE 9 quantized serving (docs/GUIDE.md "Quantized serving")
+    p.add_argument("--kv_dtype", choices=["bf16", "int8"], default="bf16",
+                   help="paged KV pool storage dtype: bf16 (default; "
+                        "bitwise greedy parity with generate_tokens) or "
+                        "int8 (per-token/group fp32 scales — ~half the "
+                        "pool bytes/token and half the decode kernels' "
+                        "cache traffic at a measured logprob drift; "
+                        "bench extra.quant reports the bound)")
+    p.add_argument("--quantize_weights", action="store_true",
+                   help="weight-only int8 decode matmuls: one-shot "
+                        "per-output-channel quantization of the decode "
+                        "qkv/dense/MLP weights (halves decode weight "
+                        "traffic; fp checkpoint untouched; decode-only)")
     args = p.parse_args()
 
     import jax
@@ -156,6 +169,8 @@ def main():
             warmup_compile=args.warmup_compile,
             prefix_cache=prefix_cache,
             spec_decode_k=args.spec_decode_k,
+            kv_dtype=args.kv_dtype,
+            quantize_weights=args.quantize_weights,
             termination_id=tokenizer.eod,
             vocab_size=tokenizer.vocab_size,
         )
@@ -163,6 +178,11 @@ def main():
           f"http://{args.host}:{args.port}/api"
           + (f" (continuous batching: {args.serving_slots} slots, "
              f"{engine.num_pages - 1} pages x {args.page_size}, "
+             f"kv_dtype={engine.kv_pool_dtype()} "
+             f"({engine.kv_pool_bytes() / 2**20:.0f} MiB pool, "
+             f"{engine.kv_bytes_per_token()} B/token), "
+             + ("int8 decode weights, " if engine.quantize_weights
+                else "")
              + (f"chunked prefill {engine.prefill_chunk_tokens} tok/round"
                 if engine.prefill_chunk_tokens else
                 "whole-prompt prefill")
