@@ -62,12 +62,21 @@ __all__ = [
 
 KNOWN_FAILURES_DOC = "KNOWN_FAILURES.md"
 
-# mesh tag -> (dp, tp). "single" is the no-mesh case.
+# mesh tag -> (dp, tp). "single" is the no-mesh case. Suffixed tags
+# ("dp2+zero1", "dp2+zero1-quant", "dp2tp2+zero1") audit the SAME mesh
+# with the distributed optimizer's specializations — the suffix selects
+# the contract's collective-inventory row, the prefix the mesh shape.
 MESH_TAGS: Dict[str, Tuple[int, int]] = {
     "single": (1, 1),
     "tp2": (1, 2),
+    "dp2": (2, 1),
     "dp2tp2": (2, 2),
 }
+
+
+def _mesh_shape_for_tag(tag: str) -> Tuple[int, int]:
+    return MESH_TAGS[tag.split("+", 1)[0]]
+
 
 _COLLECTIVE_RE = re.compile(
     r"\b(" + "|".join(re.escape(c) for c in COLLECTIVE_OPS) + r")\b")
@@ -164,6 +173,7 @@ def audit_lowered(name: str, mesh_tag: str, fn, args: tuple,
         mem = compiled.memory_analysis()
         tmp = int(mem.temp_size_in_bytes)
         res.facts["temp_bytes"] = tmp
+        res.facts["args_bytes"] = int(mem.argument_size_in_bytes)
         if contract.tmp_bytes_budget is not None \
                 and tmp > contract.tmp_bytes_budget:
             res.fail(
@@ -266,32 +276,52 @@ def _audit_engine() -> List[TargetResult]:
     return results
 
 
+def _audit_train_config():
+    """The ONE tiny reference config the train.step audits lower —
+    shared with _check_zero1_state_bytes so the state-bytes expectation
+    is always computed for the model actually audited."""
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.config import tiny_config
+
+    return tiny_config(
+        num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=4, ffn_hidden_size=128, seq_length=32,
+        max_position_embeddings=32, padded_vocab_size=128,
+        params_dtype=jnp.float32, compute_dtype=jnp.float32)
+
+
 def _audit_train_step(mesh_tag: str) -> TargetResult:
+    """Lower the train step for one mesh tag. A `+zero1` /
+    `+zero1-quant` suffix turns on the distributed optimizer (and the
+    int8 gradient reduction) — the optimizer state is sharded through
+    the SAME optimizer_state_specs path the trainer uses, so the
+    audited args bytes are the production layout's."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from megatron_llm_tpu.config import (
-        ParallelConfig,
-        TrainConfig,
-        tiny_config,
-    )
+    from megatron_llm_tpu.config import ParallelConfig, TrainConfig
     from megatron_llm_tpu.models import LlamaModel
-    from megatron_llm_tpu.optimizer.optimizer import init_optimizer_state
+    from megatron_llm_tpu.optimizer.optimizer import (
+        OptimizerState,
+        init_optimizer_state,
+    )
     from megatron_llm_tpu.parallel.mesh import (
         destroy_parallel,
         initialize_parallel,
     )
-    from megatron_llm_tpu.parallel.sharding import param_specs
+    from megatron_llm_tpu.parallel.sharding import (
+        optimizer_state_specs,
+        param_specs,
+    )
     from megatron_llm_tpu.training.train_step import make_train_step
 
-    dp, tp = MESH_TAGS[mesh_tag]
-    cfg = tiny_config(
-        num_layers=2, hidden_size=64, num_attention_heads=4,
-        num_attention_heads_kv=4, ffn_hidden_size=128, seq_length=32,
-        max_position_embeddings=32, padded_vocab_size=128,
-        params_dtype=jnp.float32, compute_dtype=jnp.float32)
+    dp, tp = _mesh_shape_for_tag(mesh_tag)
+    zero1 = "+zero1" in mesh_tag
+    quant = mesh_tag.endswith("-quant")
+    cfg = _audit_train_config()
     model = LlamaModel(cfg)
     ctx = initialize_parallel(dp=dp, pp=1, tp=tp)
     try:
@@ -303,12 +333,27 @@ def _audit_train_step(mesh_tag: str) -> TargetResult:
         params = jax.jit(model.init, out_shardings=psh)(jax.random.key(0))
         tcfg = TrainConfig(micro_batch_size=2, global_batch_size=2 * dp,
                            lr=1e-4)
-        opt_state = init_optimizer_state(params, tcfg)
         pcfg = ParallelConfig(num_microbatches=1, data_parallel_size=dp,
-                              tensor_parallel_size=tp)
+                              tensor_parallel_size=tp,
+                              use_distributed_optimizer=zero1,
+                              quantized_grad_reduce=quant)
+        if zero1:
+            ospecs = optimizer_state_specs(cfg, tmpl, dp, True,
+                                           base_specs=pspecs)
+            osh = jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs,
+                               is_leaf=lambda x: isinstance(x, P))
+            opt_state = jax.jit(
+                lambda p: init_optimizer_state(p, tcfg),
+                out_shardings=OptimizerState(
+                    step=NamedSharding(mesh, P()), m=osh, v=osh,
+                    scaler=None),
+            )(params)
+        else:
+            opt_state = init_optimizer_state(params, tcfg)
         # graft-contract: train.step
         step = jax.jit(
-            make_train_step(model, tcfg, pcfg, contract_key=("audit", 1),
+            make_train_step(model, tcfg, pcfg,
+                            contract_key=("audit", mesh_tag),
                             contract_owner=None),
             donate_argnums=(0, 1))
         tokens = jnp.asarray(
@@ -432,6 +477,53 @@ def check_contract_markers(root: str) -> List[str]:
     return problems
 
 
+def _check_zero1_state_bytes(results: List[TargetResult]) -> None:
+    """ISSUE 10 acceptance: per-device optimizer-state bytes under
+    zero1 must be <= replicated_bytes/dp (+ the documented replicated
+    residue and slack), read from the AOT memory_analysis argument
+    bytes of the SAME train step on the SAME mesh. The m/v trees are
+    the only args whose sharding changes between the two rows, so the
+    args-bytes delta IS the sharded optimizer state."""
+    by_tag = {r.mesh_tag: r for r in results if r.contract == "train.step"}
+    for base_tag, z_tag in (("dp2", "dp2+zero1"),
+                            ("dp2tp2", "dp2tp2+zero1")):
+        base, z = by_tag.get(base_tag), by_tag.get(z_tag)
+        if base is None or z is None:
+            continue
+        a_rep = base.facts.get("args_bytes")
+        a_z = z.facts.get("args_bytes")
+        if not isinstance(a_rep, int) or not isinstance(a_z, int):
+            continue  # platform without memory_analysis
+        dp, tp = _mesh_shape_for_tag(z_tag)
+        # m + v at the audit config: every leaf fp32, same sizes as the
+        # (replicated-over-dp) params — 2 trees of them
+        import jax
+        import numpy as np
+
+        from megatron_llm_tpu.models import LlamaModel
+
+        cfg = _audit_train_config()
+        tmpl = jax.eval_shape(LlamaModel(cfg).init, jax.random.key(0))
+        opt_bytes = 2 * sum(int(np.prod(l.shape)) * 4
+                            for l in jax.tree.leaves(tmpl))
+        saved = a_rep - a_z
+        # expected saving: the sharded fraction of m/v moves to 1/dp per
+        # device — from a baseline that is ALREADY 1/tp per device for
+        # the tp-sharded leaves (approximated as the whole tree / tp;
+        # norm-scale leaves are O(h) noise at this config). 10% slack
+        # absorbs the replicated residue and layout padding.
+        expected = opt_bytes / tp * (1 - 1.0 / dp)
+        z.facts["opt_state_args_saving_bytes"] = saved
+        z.facts["opt_state_expected_saving_bytes"] = int(expected)
+        if saved < expected * 0.9:
+            z.fail(
+                f"per-device optimizer-state bytes not ~1/dp: zero1 args "
+                f"{a_z} vs replicated {a_rep} saves {saved} bytes, "
+                f"expected >= {int(expected * 0.9)} (m+v {opt_bytes} B "
+                f"sharded {dp}-way) — the optimizer_state_specs sharding "
+                f"is not reaching the compiled artifact")
+
+
 def audit_repo(root: str) -> dict:
     """Run the full audit: lower every reference target, check marker
     consistency, and return a JSON-able report. Requires >= 4 devices
@@ -441,8 +533,14 @@ def audit_repo(root: str) -> dict:
     results: List[TargetResult] = []
     results.extend(_audit_engine())
     n_dev = len(jax.devices())
-    for tag in ("tp2", "dp2tp2"):
-        dp, tp = MESH_TAGS[tag]
+    # the ZeRO-1 rows (ISSUE 10): BOTH the replicated and the zero1
+    # specializations lower on the dp meshes, pinning the explicit
+    # decomposition's collective inventory (reduce-scatter on the
+    # pure-dp mesh; the quantized variant's all-to-all) and the
+    # dp-sharded optimizer-state args bytes below.
+    for tag in ("tp2", "dp2", "dp2+zero1", "dp2+zero1-quant",
+                "dp2tp2", "dp2tp2+zero1"):
+        dp, tp = _mesh_shape_for_tag(tag)
         if dp * tp > n_dev:
             r = TargetResult(contract="train.step", mesh_tag=tag)
             r.fail(f"needs {dp * tp} devices, have {n_dev} — provision "
@@ -450,6 +548,7 @@ def audit_repo(root: str) -> dict:
             results.append(r)
             continue
         results.append(_audit_train_step(tag))
+    _check_zero1_state_bytes(results)
     results.append(_audit_generate_tokens())
     results.append(_audit_chunk_topk())
     results.append(_audit_flash_attention())

@@ -372,6 +372,12 @@ def build_base_parser() -> argparse.ArgumentParser:
     # --num_layers_per_virtual_pipeline_stage is rejected via
     # DESCOPED_FLAGS (registered below) so reference scripts fail loudly.
     g.add_argument("--use_distributed_optimizer", action="store_true")
+    # ZeRO-1 explicit-decomposition knobs (ISSUE 10, optimizer/zero1.py):
+    # reduce-scatter bucket size target (MB of fp32 grad payload per
+    # collective) and the opt-in EQuARX-style int8 gradient reduction
+    # (pure-dp meshes; default OFF, fp path bitwise-unchanged)
+    g.add_argument("--grad_rs_bucket_mb", type=float, default=4.0)
+    g.add_argument("--quantized_grad_reduce", action="store_true")
     g.add_argument("--data_parallel_size", type=int, default=None)
     # context parallelism (ring attention over the sequence axis) — a
     # beyond-reference long-context axis; see ParallelConfig.
@@ -583,6 +589,8 @@ def args_to_configs(args, padded_vocab_size: int):
         context_parallel_size=cp,
         sequence_parallel=args.sequence_parallel,
         use_distributed_optimizer=args.use_distributed_optimizer,
+        grad_rs_bucket_mb=args.grad_rs_bucket_mb,
+        quantized_grad_reduce=args.quantized_grad_reduce,
         num_microbatches=num_micro,
         pipeline_remat=args.pipeline_remat,
     )

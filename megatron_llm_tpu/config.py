@@ -273,8 +273,23 @@ class ParallelConfig:
     # (ref: arguments.py:683; forced off at tp=1 per arguments.py:327-328).
     sequence_parallel: bool = False
     # ZeRO-1 optimizer-state sharding over data axis
-    # (ref: --use_distributed_optimizer arguments.py:864).
+    # (ref: --use_distributed_optimizer arguments.py:864). On pure-dp
+    # meshes with a GPT-family model the gradient reduction runs the
+    # EXPLICIT reduce-scatter/all-gather decomposition
+    # (optimizer/zero1.py); mixed meshes keep the GSPMD-spec path.
     use_distributed_optimizer: bool = False
+    # Size target (MB of fp32 gradient payload) for the explicit path's
+    # reduce-scatter buckets — the analogue of the reference's
+    # distributed.py grad-buffer packing. One collective per bucket per
+    # microbatch; smaller buckets give the latency-hiding scheduler
+    # more overlap slack, larger ones amortize collective launch.
+    grad_rs_bucket_mb: float = 4.0
+    # Opt-in EQuARX-style int8 gradient reduction (ops/quantization
+    # conventions: symmetric RTN, per-chunk fp32 scales, fp32
+    # accumulation of dequantized partials). Default OFF: the fp path
+    # is bitwise-unchanged; drift is measured in bench extra.zero1, not
+    # assumed. Requires use_distributed_optimizer on a pure-dp mesh.
+    quantized_grad_reduce: bool = False
     # Number of microbatches for pipelining / gradient accumulation.
     num_microbatches: int = 1
     # Pipeline backward rematerialization policy — the memory/FLOP trade
@@ -304,6 +319,41 @@ class ParallelConfig:
                 f"pipeline_remat={self.pipeline_remat!r}: expected one of "
                 f"{REMAT_POLICIES + ('tick', 'dots')}"
             )
+        if self.grad_rs_bucket_mb <= 0:
+            raise ValueError(
+                f"grad_rs_bucket_mb={self.grad_rs_bucket_mb}: the "
+                f"reduce-scatter bucket size target must be positive"
+            )
+        if self.quantized_grad_reduce:
+            # reject dead/misleading combinations at construction (the
+            # recompute-flag pattern above): quantization lives inside
+            # the explicit decomposition, which needs zero1 on a
+            # pure-dp mesh — anywhere else the flag would silently
+            # train full-precision.
+            if not self.use_distributed_optimizer:
+                raise ValueError(
+                    "quantized_grad_reduce requires "
+                    "use_distributed_optimizer: the int8 reduction is "
+                    "the wire format of the ZeRO-1 reduce-scatter "
+                    "(optimizer/zero1.py); without it there is no "
+                    "decomposed dp reduction to quantize"
+                )
+            if (self.tensor_parallel_size > 1
+                    or self.pipeline_parallel_size > 1
+                    or self.context_parallel_size > 1):
+                raise ValueError(
+                    "quantized_grad_reduce is only available on pure-dp "
+                    "meshes (tp=pp=cp=1): the explicit reduce-scatter "
+                    "path runs the fwd/bwd inside a data-manual "
+                    "shard_map, which cannot nest inside the tp/pp/cp "
+                    "programs on this XLA build (docs/GUIDE.md, 'ZeRO-1 "
+                    "distributed optimizer')"
+                )
+            if self.data_parallel_size <= 1:
+                raise ValueError(
+                    "quantized_grad_reduce with data_parallel_size=1: "
+                    "there is no dp gradient reduction to quantize"
+                )
 
     @property
     def resolved_pipeline_remat(self) -> str:

@@ -38,6 +38,7 @@ from megatron_llm_tpu.parallel.mesh import (
     get_context,
     in_manual_region,
     shard_activation,
+    shard_map as _shard_map,
 )
 
 
@@ -59,27 +60,35 @@ def _ring_dispatch(pctx, q, k, v, doc_start=None):
         return ring_self_attention(q, k, v, CONTEXT_AXIS, causal=True,
                                    doc_start=doc_start)
 
-    qspec = P(None, CONTEXT_AXIS, None, None, None)
-    kspec = P(None, CONTEXT_AXIS, None, None)
+    # the batch axis is manual too (the ring body is row-independent and
+    # the activations are already data-sharded): with `data` inside the
+    # manual set, pure dp x cp meshes reach this XLA build's fully-manual
+    # path instead of its broken partial-manual partitioner
+    # (parallel/mesh.py shard_map adapter) — and on newer builds it is
+    # an equivalent, equally-correct manualization.
+    from megatron_llm_tpu.parallel.mesh import DATA_AXIS
+
+    qspec = P(DATA_AXIS, CONTEXT_AXIS, None, None, None)
+    kspec = P(DATA_AXIS, CONTEXT_AXIS, None, None)
     if doc_start is None:
-        ring = jax.shard_map(
+        ring = _shard_map(
             functools.partial(
                 ring_self_attention, axis_name=CONTEXT_AXIS, causal=True
             ),
             in_specs=(qspec, kspec, kspec),
             out_specs=qspec,
-            axis_names={CONTEXT_AXIS},
+            axis_names={DATA_AXIS, CONTEXT_AXIS},
             mesh=pctx.mesh,
         )
         return ring(q, k, v)
 
-    ring = jax.shard_map(
+    ring = _shard_map(
         lambda q_, k_, v_, ds: ring_self_attention(
             q_, k_, v_, CONTEXT_AXIS, causal=True, doc_start=ds
         ),
-        in_specs=(qspec, kspec, kspec, P(None, CONTEXT_AXIS)),
+        in_specs=(qspec, kspec, kspec, P(DATA_AXIS, CONTEXT_AXIS)),
         out_specs=qspec,
-        axis_names={CONTEXT_AXIS},
+        axis_names={DATA_AXIS, CONTEXT_AXIS},
         mesh=pctx.mesh,
     )
     return ring(q, k, v, doc_start.astype(jnp.int32))

@@ -75,6 +75,47 @@ class GPTModel:
         loss_mask = loss_mask.astype(jnp.float32)
         return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
 
+    def loss_terms(
+        self,
+        params: dict,
+        tokens: jnp.ndarray,
+        labels: jnp.ndarray,
+        loss_mask: Optional[jnp.ndarray] = None,
+        position_ids: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        dropout_rng=None,
+        deterministic: bool = True,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """`loss` decomposed into (numerator, denominator) so a caller
+        that holds only a DATA-PARALLEL SLICE of the batch can rebuild
+        the global loss exactly: both terms are row-additive, so
+        psum(num) / max(psum(den), 1) reproduces `loss`'s op chain
+        bitwise (the ZeRO-1 explicit reduce-scatter path,
+        optimizer/zero1.py, differentiates num/max(global_den, 1) to
+        get the identical backward cotangent). The masked form uses the
+        exact expressions of `loss`; the unmasked denominator is the
+        token count."""
+        hidden, _ = language_model_forward(
+            params, self.cfg, tokens, position_ids, attention_mask,
+            dropout_rng, deterministic, return_hidden=True,
+        )
+        losses = chunked_head_cross_entropy(params, self.cfg, hidden, labels)
+        if loss_mask is None:
+            return jnp.sum(losses), jnp.float32(losses.size)
+        loss_mask = loss_mask.astype(jnp.float32)
+        return jnp.sum(losses * loss_mask), jnp.sum(loss_mask)
+
+    def loss_denominator(self, tokens=None, labels=None, loss_mask=None,
+                         **_) -> jnp.ndarray:
+        """The `loss_terms` denominator from mask arithmetic alone (no
+        forward pass, no params): what the explicit ZeRO-1 path psums
+        BEFORE the backward so the local grad target can divide by the
+        global count."""
+        if loss_mask is None:
+            ref = labels if labels is not None else tokens
+            return jnp.float32(ref.size)
+        return jnp.sum(loss_mask.astype(jnp.float32))
+
     def prepare_decode_params(self, params: dict,
                               quantize_int8: bool = False) -> dict:
         """Decode-layout view of the params, built ONCE before the token

@@ -28,6 +28,82 @@ CONTEXT_AXIS = "context"
 MODEL_AXIS = "model"
 AXIS_NAMES = (DATA_AXIS, STAGE_AXIS, CONTEXT_AXIS, MODEL_AXIS)
 
+# jax.shard_map landed as a top-level name only on newer JAX lines; the
+# baked-in 0.4.37 still spells it jax.experimental.shard_map.shard_map
+# and declares manual axes as `auto` (the complement of the new API's
+# `axis_names`). Every call site imports THIS adapter, so the whole
+# pp/cp/zero1 shard_map surface works on both lines — this was the
+# KNOWN_FAILURES.md "jax.shard_map AttributeError" drift that
+# dead-ended the pipeline/context-parallel/pp-inference slow suites and
+# the pp>1 MULTICHIP dryrun layouts in this environment.
+if hasattr(jax, "shard_map"):
+    import inspect as _inspect
+
+    _new_params = _inspect.signature(jax.shard_map).parameters
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_rep=True, auto=frozenset()):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        # the rep/vma checker kwarg was renamed check_rep -> check_vma
+        # on the new surface; pass whichever this jax spells
+        if "check_vma" in _new_params:
+            kw["check_vma"] = check_rep
+        elif "check_rep" in _new_params:
+            kw["check_rep"] = check_rep
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_rep=True, auto=frozenset()):
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        # size-1 auto axes are vacuous — treat them as manual. This is
+        # load-bearing: ANY non-empty auto set routes this XLA build
+        # into its partial-manual partitioner, which is broken
+        # (PartitionId UNIMPLEMENTED, or a hard IsManualSubgroup CHECK
+        # that ABORTS the process) — so pure-pp/cp/dp meshes must reach
+        # it with auto = {} to work at all. Genuinely mixed meshes are
+        # rejected HERE with a catchable error: the CHECK-abort variant
+        # would otherwise kill the whole test/serve process.
+        auto = frozenset(a for a in auto if mesh.shape[a] > 1)
+        if auto:
+            raise NotImplementedError(
+                f"partial-manual shard_map (manual={sorted(set(mesh.axis_names) - auto)}, "
+                f"auto={sorted(auto)}) is broken in this jax/XLA build "
+                f"(0.4.37 CPU partitioner: PartitionId UNIMPLEMENTED / "
+                f"IsManualSubgroup CHECK abort). Use a mesh where the "
+                f"non-manual axes are size 1, or a newer jax with "
+                f"jax.shard_map (KNOWN_FAILURES.md)")
+        # the experimental rep-checker predates the varying-manual type
+        # system the new call sites are written for (lax.pcast markers,
+        # check_vma) — its inference rejects bodies the new API accepts.
+        # Replication checking is a diagnostic, not a semantic: off.
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False,
+                              auto=auto)
+
+
+def pcast(x, axes, to="varying"):
+    """jax.lax.pcast where it exists (the new varying-manual type
+    system); a no-op marker on older lines, where the experimental
+    shard_map (check_rep=False above) needs no varying annotations."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to=to)
+    return x
+
+
+def axis_size(name) -> int:
+    """jax.lax.axis_size where it exists; on older lines the canonical
+    psum-of-1 idiom, which trace-time folds to a concrete int inside
+    shard_map bodies."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return int(jax.lax.psum(1, name))
+
 _CONTEXT: Optional["ParallelContext"] = None
 
 
@@ -199,29 +275,64 @@ _ACTIVATION_SPECS = {
 
 
 _MANUAL_DEPTH = 0
+_BARRIER_DEPTH = 0
 
 
 @contextlib.contextmanager
-def manual_region():
+def manual_region(constraint_barriers: bool = False):
     """Mark a shard_map(manual-axes) body: activation constraints are
     skipped inside (this JAX rejects with_sharding_constraint mixing auto
     axes into a manual region; GSPMD propagation from the param shardings
-    covers the body instead)."""
-    global _MANUAL_DEPTH
+    covers the body instead).
+
+    `constraint_barriers=True` (the explicit ZeRO-1 path,
+    optimizer/zero1.py): each skipped constraint site emits a
+    `lax.optimization_barrier` instead of nothing. A sharding
+    constraint is a fusion boundary in the GSPMD program; without a
+    stand-in, the manual program fuses elementwise chains differently
+    and bf16 intermediates round differently — measured on the CPU
+    backend as a per-layer last-ulp forward divergence. The barrier
+    reproduces the replicated program's fusion boundaries, which is
+    what makes the zero1-vs-replicated BITWISE contract hold in bf16
+    (tests/test_zero1.py)."""
+    global _MANUAL_DEPTH, _BARRIER_DEPTH
     _MANUAL_DEPTH += 1
+    _BARRIER_DEPTH += 1 if constraint_barriers else 0
     try:
         yield
     finally:
         _MANUAL_DEPTH -= 1
+        _BARRIER_DEPTH -= 1 if constraint_barriers else 0
 
 
 def in_manual_region() -> bool:
     return _MANUAL_DEPTH > 0
 
 
+@jax.custom_vjp
+def _fusion_barrier(x):
+    return jax.lax.optimization_barrier(x)
+
+
+def _fusion_barrier_fwd(x):
+    return jax.lax.optimization_barrier(x), None
+
+
+def _fusion_barrier_bwd(_, ct):
+    # with_sharding_constraint transposes to a constraint on the
+    # cotangent — the replicated program's BACKWARD has the same fusion
+    # boundaries, so the stand-in must too
+    return (jax.lax.optimization_barrier(ct),)
+
+
+_fusion_barrier.defvjp(_fusion_barrier_fwd, _fusion_barrier_bwd)
+
+
 def shard_activation(x, kind: str):
     ctx = _CONTEXT
     if ctx is None or _MANUAL_DEPTH:
+        if ctx is not None and _BARRIER_DEPTH:
+            return _fusion_barrier(x)
         return x
     spec = _ACTIVATION_SPECS[kind]
     if kind == "hidden_seq" and not ctx.sequence_parallel:

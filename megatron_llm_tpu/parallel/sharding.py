@@ -110,6 +110,20 @@ def param_shardings(ctx: ParallelContext, cfg, params: dict) -> dict:
     )
 
 
+def zero1_axis(spec: P, shape: tuple, dp: int) -> Optional[int]:
+    """The leaf axis ZeRO-1 shards over `data`: the first free axis
+    divisible by dp, or None when no such axis exists (the replicated
+    residue — see zero1_spec). The ONE divisibility rule: zero1_spec,
+    the explicit reduce-scatter plan (optimizer/zero1.py), and the audit
+    all derive from this so they can never disagree on which leaves are
+    sharded."""
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (p, n) in enumerate(zip(parts, shape)):
+        if p is None and n % dp == 0 and n >= dp:
+            return i
+    return None
+
+
 def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
     """Add the `data` axis to the first free axis divisible by dp — the
     GSPMD form of the reference's flat-buffer range sharding
@@ -124,12 +138,12 @@ def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
     Llama-2-7B at dp=8: ~0.9 MB replicated vs ~3.4 GB/device sharded
     moments (<0.03%). The trade buys per-leaf resharding on restore (the
     checkpoint is mesh-shape-free) and no gather/scatter bookkeeping."""
+    k = zero1_axis(spec, shape, dp)
+    if k is None:
+        return spec
     parts = list(spec) + [None] * (len(shape) - len(spec))
-    for i, (p, n) in enumerate(zip(parts, shape)):
-        if p is None and n % dp == 0 and n >= dp:
-            parts[i] = DATA_AXIS
-            return P(*parts)
-    return spec
+    parts[k] = DATA_AXIS
+    return P(*parts)
 
 
 def optimizer_state_specs(cfg, params: dict, dp: int, distributed: bool,

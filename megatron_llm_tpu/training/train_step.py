@@ -31,26 +31,55 @@ from megatron_llm_tpu.optimizer.optimizer import OptimizerState, optimizer_step
 
 @compile_contract(
     "train.step",
-    max_variants=8,  # num_microbatches buckets per trainer; the trainer
+    max_variants=12,  # num_microbatches buckets per trainer; the trainer
     # passes contract_key=num_microbatches so a microbatch-schedule
-    # change that re-traces per step fails loudly at mint time
+    # change that re-traces per step fails loudly at mint time. Raised
+    # 8 -> 12 with the ZeRO-1 audit specializations (dp2 replicated /
+    # zero1 / zero1-quantized, dp2tp2 zero1) minting in the global
+    # bucket alongside the original tp2/dp2tp2 pair.
     collectives={
         "single": frozenset(),
         # pinned on the audit reference config (analysis/audit.py):
         # the TP activation/logit reductions lower to all-reduce, the
         # GSPMD param/embedding gathers to all-gather; dp grad
-        # reduction folds into the same all-reduce family. ZeRO-1
-        # (ROADMAP item 2) is expected to ADD reduce-scatter here —
-        # that PR updates this declaration with its justification.
+        # reduction folds into the same all-reduce family.
         "tp2": frozenset({"all-reduce", "all-gather"}),
         "dp2tp2": frozenset({"all-reduce", "all-gather"}),
+        # pure-dp replicated adam: the dp grad reduction + scalar
+        # reductions are the only collectives
+        "dp2": frozenset({"all-reduce"}),
+        # ZeRO-1 explicit decomposition (optimizer/zero1.py): the ISSUE
+        # 10 contract — per-bucket reduce-scatter of grads, all-gather
+        # of updated params, all-reduce for loss/denominator/grad-norm
+        # scalars and the replicated residue leaves
+        "dp2+zero1": frozenset(
+            {"all-reduce", "all-gather", "reduce-scatter"}),
+        # quantized grad reduction: the bucket exchange is an int8
+        # all-to-all (+ fp32 scales) instead of a reduce-scatter
+        "dp2+zero1-quant": frozenset(
+            {"all-reduce", "all-gather", "all-to-all"}),
+        # mixed-mesh zero1 keeps the GSPMD-spec path: no explicit
+        # reduce-scatter op on this CPU pipeline (TPU's SPMD partitioner
+        # forms one from the steered all-reduce+slice; not witnessable
+        # in the CPU audit — GUIDE.md). The constrained grads/update DO
+        # lower to real resharding collectives here: all-to-all and
+        # collective-permute move the dp-sharded update shards, the
+        # all-gather reassembles params — pinned at the audit config.
+        "dp2tp2+zero1": frozenset(
+            {"all-reduce", "all-gather", "all-to-all",
+             "collective-permute"}),
     },
     tmp_bytes_budget=2 << 20,
-    notes="the one fused fwd+bwd+optimizer step; audited on tp2 and "
-          "dp2x2 CPU meshes at the tiny reference config")
-def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
+    notes="the one fused fwd+bwd+optimizer step; audited on tp2/dp2/"
+          "dp2x2 CPU meshes at the tiny reference config, zero1 "
+          "(explicit + GSPMD-spec + quantized) specializations included")
+def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig,
+                    batch_builder=None):
     """Returns train_step(params, opt_state, batch, lr, wd, rng,
-    spike_threshold).
+    spike_threshold). `batch_builder` is the trainer's raw-batch
+    adapter when one is installed — its presence excludes the explicit
+    ZeRO-1 path (the builder's batch leaves/kwargs are not the GPT
+    loss_terms surface the shard_map body splats).
 
     `batch` dict of (num_microbatches, batch, seq) arrays with keys
     tokens / labels / loss_mask (loss_mask optional). When
@@ -69,11 +98,59 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
     same found_inf machinery the fp16 scaler uses, so bf16 runs get the
     identical no-host-round-trip skip path. Pass +inf for "no spike
     gating, still skip NaN/inf losses".
+
+    ZeRO-1 (`pcfg.use_distributed_optimizer`, ISSUE 10): on pure-dp
+    meshes with a loss_terms model (the GPT family) the gradient
+    reduction is the EXPLICIT decomposition (optimizer/zero1.py):
+    per-bucket reduce-scatter per microbatch into a dp-sharded fp32
+    accumulator (opt-in int8-quantized wire via
+    `pcfg.quantized_grad_reduce`), shard-local Adam on the dp-sharded
+    m/v, then an all-gather of the updated params — bitwise-identical
+    to the replicated path when quantization is off (tests/
+    test_zero1.py). On mixed meshes (tp/cp > 1) the GSPMD-spec path
+    steers the same layout with sharding constraints (all-reduce +
+    slice on CPU; TPU forms reduce-scatter from the pattern).
     """
     from megatron_llm_tpu.optimizer.optimizer import get_grad_scaler
+    from megatron_llm_tpu.optimizer.zero1 import (
+        build_zero1_plan,
+        explicit_zero1_supported,
+        make_zero1_grad_fn,
+    )
+    from megatron_llm_tpu.parallel.mesh import get_context
 
     num_micro = pcfg.num_microbatches
     scaler = get_grad_scaler(tcfg)
+    ctx = get_context()
+    use_explicit = explicit_zero1_supported(model, pcfg, ctx,
+                                            batch_builder=batch_builder)
+    if pcfg.quantized_grad_reduce and not use_explicit:
+        # the mesh-SHAPE combinations are rejected at config
+        # construction; what remains here: a model without loss_terms
+        # (BERT/T5/biencoder), an installed batch_builder, or a
+        # missing/mismatched mesh context — falling back would silently
+        # train full-precision under a flag that promises int8
+        blocker = (
+            "no mesh context installed" if ctx is None
+            else f"mesh dp={ctx.dp} != configured "
+                 f"dp={pcfg.data_parallel_size}"
+            if ctx.dp != pcfg.data_parallel_size
+            else "a batch_builder is installed (its batch is not the "
+                 "loss_terms surface)" if batch_builder is not None
+            else f"{type(model).__name__} exposes no loss_terms "
+                 f"(GPT-family models do)")
+        raise ValueError(
+            "quantized_grad_reduce requires the explicit ZeRO-1 path, "
+            f"which this run cannot take: {blocker}. Drop the flag or "
+            "remove the blocker (docs/GUIDE.md, 'ZeRO-1 distributed "
+            "optimizer')")
+    zero1_gspmd = (
+        not use_explicit
+        and ctx is not None
+        and pcfg.use_distributed_optimizer
+        and pcfg.data_parallel_size > 1
+        and pcfg.pipeline_parallel_size == 1
+    )
 
     def loss_on_micro(params, micro, rng, loss_scale):
         # the batch dict's keys ARE the model-loss kwargs: GPT batches
@@ -91,17 +168,65 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
             return loss * loss_scale, loss
         return loss, loss
 
+    def _zero1_constrain(tree, params):
+        """Mixed-mesh GSPMD-spec steering: pin each grad leaf to its
+        zero1 spec so the m/v update runs shard-wise (the slice happens
+        at the reduction, not after a full materialization)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from megatron_llm_tpu.parallel.sharding import (
+            param_specs,
+            zero1_spec,
+        )
+
+        specs = param_specs(model.cfg, params)
+        flat_t, treedef = jax.tree.flatten(tree)
+        flat_s, _ = jax.tree.flatten(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        out = [
+            jax.lax.with_sharding_constraint(
+                t, NamedSharding(
+                    ctx.mesh, zero1_spec(s, t.shape,
+                                         pcfg.data_parallel_size)))
+            for t, s in zip(flat_t, flat_s)
+        ]
+        return jax.tree.unflatten(treedef, out)
+
+    def _gather_params(new_params, params):
+        """The all-gather leg of the decomposition: updated params back
+        to their dp-replicated (tp/pp-sharded) serving layout."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from megatron_llm_tpu.parallel.sharding import param_specs
+
+        specs = param_specs(model.cfg, params)
+        flat_p, treedef = jax.tree.flatten(new_params)
+        flat_s, _ = jax.tree.flatten(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        return jax.tree.unflatten(treedef, [
+            jax.lax.with_sharding_constraint(t, NamedSharding(ctx.mesh, s))
+            for t, s in zip(flat_p, flat_s)
+        ])
+
     def train_step(params, opt_state: OptimizerState, batch, lr, wd,
                    rng=None, spike_threshold=None):
         loss_scale = (
             scaler.scale(opt_state.scaler) if scaler is not None else None
         )
-        grad_fn = jax.value_and_grad(loss_on_micro, has_aux=True)
-
-        if num_micro == 1:
+        if use_explicit:
+            plan = build_zero1_plan(
+                model.cfg, params, pcfg.data_parallel_size,
+                bucket_mb=pcfg.grad_rs_bucket_mb)
+            zgrad = make_zero1_grad_fn(
+                model, ctx, plan, num_micro,
+                quantized=pcfg.quantized_grad_reduce)
+            grads, loss = zgrad(params, batch, rng, loss_scale)
+        elif num_micro == 1:
+            grad_fn = jax.value_and_grad(loss_on_micro, has_aux=True)
             micro = jax.tree.map(lambda x: x[0], batch)
             (_, loss), grads = grad_fn(params, micro, rng, loss_scale)
         else:
+            grad_fn = jax.value_and_grad(loss_on_micro, has_aux=True)
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params
             )
@@ -124,6 +249,9 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
             grads = jax.tree.map(lambda g: g / num_micro, grads)
             loss = loss / num_micro
 
+        if zero1_gspmd:
+            grads = _zero1_constrain(grads, params)
+
         if scaler is not None:
             # unscale; the overflow check rides optimizer_step's grad norm
             inv = 1.0 / loss_scale
@@ -142,6 +270,12 @@ def make_train_step(model, tcfg: TrainConfig, pcfg: ParallelConfig):
             params, grads, opt_state, tcfg, lr, weight_decay=wd,
             found_inf=found_inf, scaler=scaler,
         )
+        if use_explicit or zero1_gspmd:
+            # the all-gather leg: each dp rank computed only its shard
+            # of the update (grads + m/v arrive dp-sharded, so GSPMD
+            # keeps the elementwise Adam shard-wise); this constraint
+            # reassembles the dp-replicated params for the next forward
+            new_params = _gather_params(new_params, params)
         stats["loss"] = loss
         return new_params, new_state, stats
 

@@ -331,6 +331,7 @@ class Trainer:
             else:
                 fn = make_train_step(
                     self.model, self.tcfg, pcfg,
+                    batch_builder=self.batch_builder,
                     contract_key=num_microbatches, contract_owner=self,
                 )
             # ONE jit site serves both branches:
@@ -355,6 +356,50 @@ class Trainer:
         facts = {"remat-policy": self.cfg.resolved_remat_policy}
         if self.pcfg.pipeline_parallel_size > 1:
             facts["pipeline-remat"] = self.pcfg.resolved_pipeline_remat
+        if self.pcfg.use_distributed_optimizer:
+            # ZeRO-1 facts (ISSUE 10): which decomposition is active,
+            # the per-device optimizer-state bytes actually committed
+            # (read from the LIVE opt-state shardings, not the specs),
+            # and the analytic dp gradient-wire bytes per step — the
+            # numbers the llama7b-v5p64 sizing math is made of.
+            from megatron_llm_tpu.optimizer.zero1 import (
+                build_zero1_plan,
+                explicit_zero1_supported,
+            )
+
+            opt_state = lower_args[1]
+            facts["zero1-path"] = (
+                "explicit-rs" if explicit_zero1_supported(
+                    self.model, self.pcfg, self.ctx,
+                    batch_builder=self.batch_builder)
+                else "gspmd-spec")
+            if self.pcfg.quantized_grad_reduce:
+                facts["zero1-quantized-reduce"] = True
+            try:
+                per_dev = 0
+                for leaf in jax.tree.leaves(
+                        (opt_state.m, opt_state.v)):
+                    shard = leaf.sharding.shard_shape(leaf.shape)
+                    per_dev += int(np.prod(shard)) * leaf.dtype.itemsize
+                facts["opt-state-bytes-device"] = per_dev
+            except Exception:
+                pass
+            if facts["zero1-path"] == "explicit-rs":
+                plan = build_zero1_plan(
+                    self.cfg, lower_args[0],
+                    self.pcfg.data_parallel_size,
+                    bucket_mb=self.pcfg.grad_rs_bucket_mb)
+                params_bytes = sum(
+                    int(np.prod(l.shape)) * l.dtype.itemsize
+                    for l in jax.tree.leaves(lower_args[0]))
+                num_micro = jax.tree.leaves(lower_args[2])[0].shape[0]
+                facts["grad-comm-bytes-step"] = (
+                    plan.comm_bytes_per_reduce(
+                        self.pcfg.quantized_grad_reduce)
+                    * num_micro
+                    + params_bytes  # the param all-gather leg
+                )
+                facts["grad-rs-buckets"] = len(plan.buckets)
         if self._tb_writer is not None \
                 and self.tcfg.log_memory_to_tensorboard:
             try:

@@ -704,3 +704,34 @@ def test_prefill_bucketing_bounds_executables(served_engine):
                                   tokens_to_generate=2, top_k_sampling=1)
     assert generate_tokens._cache_size() == before, \
         "same-bucket prompt lengths must not mint new executables"
+
+
+def test_beam_search_pp_overlimit_fails_loudly(monkeypatch):
+    """VERDICT weak #7 (ISSUE 10 satellite, tier-1 pin): on a pp>1 mesh
+    an over-limit model must make beam search FAIL LOUDLY with the
+    documented alternatives — the same PP_DECODE_RESHARD_LIMIT_BYTES
+    size-dispatch `generate` uses — before any device work or reshard
+    happens. (The under-limit reshard path and its exact-match vs the
+    mesh-free beam are pinned in tests/test_pp_inference.py.)"""
+    from megatron_llm_tpu.config import tiny_config
+    from megatron_llm_tpu.inference import api
+    from megatron_llm_tpu.models import LlamaModel
+    from megatron_llm_tpu.parallel.mesh import (
+        destroy_parallel,
+        initialize_parallel,
+    )
+
+    cfg = tiny_config(seq_length=16, max_position_embeddings=16)
+    import jax
+
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    monkeypatch.setattr(api, "PP_DECODE_RESHARD_LIMIT_BYTES", 1)
+    initialize_parallel(dp=1, pp=2, tp=1)
+    try:
+        with pytest.raises(ValueError, match="no stage-ring beam"):
+            api.beam_search_and_post_process(
+                model, params, object(), ["hi"],
+                tokens_to_generate=4, beam_size=2)
+    finally:
+        destroy_parallel()
