@@ -1012,6 +1012,154 @@ def zero1_stats(dp=2, steps=50, seq=64, hidden=128, layers=4):
     return out
 
 
+def overlap_stats(dp=2, steps=6, seq=64, hidden=128, layers=4,
+                  bucket_mb=0.05):
+    """The `extra.overlap` harness (ISSUE 12): eager ZeRO-1 vs the
+    overlap-scheduled trainer (--overlap_grad_reduce +
+    --overlap_param_gather) on a dp-way virtual CPU mesh, same
+    model/data/seeds. CPU measures STRUCTURE, not speed: the losses
+    are asserted bitwise in-row, the per-step async -start/-done pair
+    count is measured from the compiled HLO by analysis/overlap.py (an
+    honest 0 on CPU — this backend has no async collectives; the same
+    field is the real pair count when this row runs on TPU, which is
+    where the step_ms delta becomes meaningful), and the sync-schedule
+    interleave witness (reduce-scatter gaps carrying the per-group
+    backward) proves the issue points survived compilation. step_ms is
+    the median of the post-compile steps — on CPU a layout-relative
+    number only; the overlap win is an ICI-latency effect the CPU
+    timing cannot show, as the methodology states."""
+    import numpy as np
+
+    from megatron_llm_tpu.analysis.overlap import (
+        collective_overlap_report,
+    )
+    from megatron_llm_tpu.config import tiny_config
+    from megatron_llm_tpu.parallel.mesh import (
+        destroy_parallel,
+        initialize_parallel,
+    )
+    from megatron_llm_tpu.training.trainer import Trainer, get_batch
+
+    assert len(jax.devices()) >= dp, (len(jax.devices()), dp)
+    cfg = tiny_config(
+        num_layers=layers, hidden_size=hidden, num_attention_heads=8,
+        num_attention_heads_kv=4, ffn_hidden_size=2 * hidden,
+        seq_length=seq, max_position_embeddings=seq,
+        padded_vocab_size=512, compute_dtype=jnp.float32,
+        params_dtype=jnp.float32)
+    num_micro, mbs = 2, 2
+    rows = mbs * dp
+
+    def run(overlap, n_steps):
+        ctx = initialize_parallel(dp=dp, pp=1, tp=1)
+        try:
+            tcfg = TrainConfig(
+                micro_batch_size=mbs, global_batch_size=num_micro * rows,
+                lr=1e-3, train_iters=n_steps)
+            pcfg = ParallelConfig(
+                data_parallel_size=dp, num_microbatches=num_micro,
+                use_distributed_optimizer=True,
+                overlap_grad_reduce=overlap,
+                overlap_param_gather=overlap,
+                grad_rs_bucket_mb=bucket_mb)
+            trainer = Trainer(LlamaModel(cfg), tcfg, pcfg)
+            state = trainer.setup()
+            rs = np.random.RandomState(0)
+            losses, times = [], []
+            for _ in range(n_steps):
+                text = rs.randint(
+                    0, 512, (num_micro, rows, seq + 1)).astype(np.int32)
+                t0 = time.perf_counter()
+                losses.append(
+                    float(trainer.train_step(state, text)["loss"]))
+                times.append((time.perf_counter() - t0) * 1e3)
+            text = rs.randint(0, 512,
+                              (num_micro, rows, seq + 1)).astype(np.int32)
+            batch = get_batch(text, None)
+            txt = trainer._get_step_fn(num_micro).lower(
+                state.params, state.opt_state, batch,
+                jnp.float32(1e-3), jnp.float32(0.01), None,
+                jnp.float32(float("inf"))).compile().as_text()
+            rep = collective_overlap_report(txt)
+            rs_gaps = rep.compute_between.get("reduce-scatter", [])
+            post = times[1:] if len(times) > 1 else times
+            med = sorted(post)[len(post) // 2]
+            return {
+                "losses": losses,
+                "step_ms_median": round(med, 2),
+                "step_ms_n": len(post),
+                "async_collective_pairs": rep.async_pairs,
+                "collective_counts": rep.collective_counts,
+                "rs_interleaved_gaps":
+                    sum(1 for g in rs_gaps if g >= 2),
+            }
+        finally:
+            destroy_parallel()
+
+    eager = run(False, steps)
+    over = run(True, steps)
+    bitwise = eager["losses"] == over["losses"]
+    out = {
+        "dp": dp,
+        "steps": steps,
+        "overlap_vs_eager_step_ms": round(
+            over["step_ms_median"] / max(eager["step_ms_median"], 1e-9),
+            3),
+        "overlap_losses_bitwise_vs_eager": bitwise,
+        "eager": {k: v for k, v in eager.items() if k != "losses"},
+        "overlap": {k: v for k, v in over.items() if k != "losses"},
+        "methodology": (
+            f"dp{dp} virtual CPU mesh, {layers}L/h{hidden}/seq{seq} fp32 "
+            f"Llama-arch, identical data stream/seeds; eager zero1 vs "
+            f"overlap_grad_reduce+overlap_param_gather at "
+            f"grad_rs_bucket_mb={bucket_mb}; step_ms median of "
+            f"{steps - 1} post-compile steps — CPU layout-relative only "
+            f"(sync collectives; the overlap win is ICI latency hiding, "
+            f"measurable only on TPU where async_collective_pairs "
+            f"counts real -start/-done pairs — 0 here is a MEASURED "
+            f"property of this backend, analysis/overlap.py); "
+            f"rs_interleaved_gaps = reduce-scatter gaps carrying >= 2 "
+            f"heavy ops (the per-group backward loops), the CPU-visible "
+            f"witness of the backward-interleaved schedule; losses "
+            f"asserted bitwise eager==overlap in-row")
+    }
+    assert bitwise, (
+        "overlap-scheduled losses diverged from eager zero1 — the "
+        "bitwise contract (tests/test_overlap.py) is broken")
+    assert over["rs_interleaved_gaps"] >= 1, over
+    return out
+
+
+def run_overlap_bench():
+    """bench artifact wrapper for extra.overlap — virtual-CPU
+    subprocess, like run_zero1_bench."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from megatron_llm_tpu.utils.virtual_mesh import (
+        force_virtual_cpu_devices,
+    )
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = force_virtual_cpu_devices(8, dict(os.environ))
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"import sys; sys.path.insert(0, {repo!r})\n"
+        "import json\n"
+        "from bench import overlap_stats\n"
+        "print('OVERLAP: ' + json.dumps(overlap_stats()))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=repo,
+                          capture_output=True, text=True, timeout=1800)
+    for line in proc.stdout.splitlines():
+        if line.startswith("OVERLAP: "):
+            return json.loads(line[len("OVERLAP: "):])
+    return {"error": (proc.stderr or proc.stdout)[-300:]}
+
+
 def run_zero1_bench():
     """bench artifact wrapper: the TPU bench machine has ONE chip, so
     the dp-mesh harness runs in a subprocess on virtual CPU devices
@@ -1288,6 +1436,7 @@ def main():
     quant = run_quant()
     ckpt = run_ckpt_bench()
     zero1 = run_zero1_bench()
+    overlap = run_overlap_bench()
     achieved = tok1 * 6 * n_params
     baseline = 890.0 * 6 * 7.0e9  # A100 anchor, BASELINE.md
     print(json.dumps({
@@ -1345,6 +1494,15 @@ def main():
                f"drift {zero1['quantized_max_rel_loss_drift']:.1e} over "
                f"{zero1['quantized_drift_steps']} steps"
                if "error" not in zero1 else "")
+            + (f"; overlap-scheduled zero1 (CPU harness): losses "
+               f"bitwise vs eager, "
+               f"{overlap['overlap']['rs_interleaved_gaps']} "
+               f"backward-interleaved reduce-scatter gaps, step_ms "
+               f"ratio {overlap['overlap_vs_eager_step_ms']}x "
+               f"(CPU-relative; async pairs measured "
+               f"{overlap['overlap']['async_collective_pairs']} on this "
+               f"backend, real on TPU)"
+               if "error" not in overlap else "")
         ),
         "value": round(tok1, 1),
         "unit": "tokens/sec/chip",
@@ -1372,6 +1530,7 @@ def main():
             "quant": quant,
             "ckpt": ckpt,
             "zero1": zero1,
+            "overlap": overlap,
         },
     }))
 

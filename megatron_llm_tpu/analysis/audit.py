@@ -169,6 +169,14 @@ def audit_lowered(name: str, mesh_tag: str, fn, args: tuple,
             "into jnp.asarray, np default dtypes) is leaking into the "
             "traced graph")
 
+    # collective-overlap evidence (ISSUE 12): async -start/-done pairs
+    # (a measured 0 on this CPU backend — the same parser counts real
+    # pairs on TPU) plus the sync-schedule interleaving the overlap
+    # specializations are pinned against (_check_overlap_schedule)
+    from megatron_llm_tpu.analysis.overlap import collective_overlap_report
+
+    res.facts["overlap"] = collective_overlap_report(text).to_dict()
+
     try:
         mem = compiled.memory_analysis()
         tmp = int(mem.temp_size_in_bytes)
@@ -276,16 +284,20 @@ def _audit_engine() -> List[TargetResult]:
     return results
 
 
-def _audit_train_config():
+def _audit_train_config(num_layers: int = 2):
     """The ONE tiny reference config the train.step audits lower —
     shared with _check_zero1_state_bytes so the state-bytes expectation
-    is always computed for the model actually audited."""
+    is always computed for the model actually audited. The `+overlap`
+    rows lower a 4-layer variant: overlap groups have a 2-layer floor
+    (optimizer/zero1.py build_overlap_plan — 1-layer groups unroll and
+    break the bitwise contract), so 2 layers would collapse to ONE
+    group and leave no boundary for the interleave pin to witness."""
     import jax.numpy as jnp
 
     from megatron_llm_tpu.config import tiny_config
 
     return tiny_config(
-        num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_layers=num_layers, hidden_size=64, num_attention_heads=4,
         num_attention_heads_kv=4, ffn_hidden_size=128, seq_length=32,
         max_position_embeddings=32, padded_vocab_size=128,
         params_dtype=jnp.float32, compute_dtype=jnp.float32)
@@ -320,8 +332,9 @@ def _audit_train_step(mesh_tag: str) -> TargetResult:
 
     dp, tp = _mesh_shape_for_tag(mesh_tag)
     zero1 = "+zero1" in mesh_tag
-    quant = mesh_tag.endswith("-quant")
-    cfg = _audit_train_config()
+    quant = "-quant" in mesh_tag
+    overlap = "+overlap" in mesh_tag
+    cfg = _audit_train_config(num_layers=4 if overlap else 2)
     model = LlamaModel(cfg)
     ctx = initialize_parallel(dp=dp, pp=1, tp=tp)
     try:
@@ -331,15 +344,31 @@ def _audit_train_step(mesh_tag: str) -> TargetResult:
         psh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
                            is_leaf=lambda x: isinstance(x, P))
         params = jax.jit(model.init, out_shardings=psh)(jax.random.key(0))
-        tcfg = TrainConfig(micro_batch_size=2, global_batch_size=2 * dp,
+        # overlap rows lower the PRODUCTION shape of the schedule: >1
+        # microbatch (the per-microbatch issue points live in the scan
+        # body, where the scheduler demonstrably interleaves them — in
+        # a single-microbatch entry computation the CPU list scheduler
+        # is free to sink the collectives into a clump, which says
+        # nothing about the dataflow the TPU scheduler overlaps) and a
+        # bucket target small enough that the 4-layer model splits into
+        # >1 layer group (one group would leave no boundary for the
+        # interleave pin in _check_overlap_schedule to witness).
+        num_micro = 2 if overlap else 1
+        tcfg = TrainConfig(micro_batch_size=2,
+                           global_batch_size=num_micro * 2 * dp,
                            lr=1e-4)
-        pcfg = ParallelConfig(num_microbatches=1, data_parallel_size=dp,
+        pcfg = ParallelConfig(num_microbatches=num_micro,
+                              data_parallel_size=dp,
                               tensor_parallel_size=tp,
                               use_distributed_optimizer=zero1,
-                              quantized_grad_reduce=quant)
+                              quantized_grad_reduce=quant,
+                              overlap_grad_reduce=overlap,
+                              overlap_param_gather=overlap,
+                              grad_rs_bucket_mb=0.05 if overlap else 4.0)
         if zero1:
             ospecs = optimizer_state_specs(cfg, tmpl, dp, True,
-                                           base_specs=pspecs)
+                                           base_specs=pspecs,
+                                           overlap_grads=overlap)
             osh = jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs,
                                is_leaf=lambda x: isinstance(x, P))
             opt_state = jax.jit(
@@ -357,7 +386,7 @@ def _audit_train_step(mesh_tag: str) -> TargetResult:
                             contract_owner=None),
             donate_argnums=(0, 1))
         tokens = jnp.asarray(
-            np.zeros((1, 2 * dp, cfg.seq_length), np.int32))
+            np.zeros((num_micro, 2 * dp, cfg.seq_length), np.int32))
         tokens = jax.device_put(
             tokens, NamedSharding(mesh, P(None, "data", None)))
         batch = {"tokens": tokens, "labels": tokens}
@@ -485,6 +514,11 @@ def _check_zero1_state_bytes(results: List[TargetResult]) -> None:
     the only args whose sharding changes between the two rows, so the
     args-bytes delta IS the sharded optimizer state."""
     by_tag = {r.mesh_tag: r for r in results if r.contract == "train.step"}
+    # NOTE: the +overlap rows lower a 4-layer variant config, so their
+    # args bytes are not comparable to the 2-layer dp2 baseline here;
+    # the overlap layout's 1/dp state sharding is pinned by
+    # tests/test_overlap.py (optimizer_state_specs unit + live-sharding
+    # gauges) instead.
     for base_tag, z_tag in (("dp2", "dp2+zero1"),
                             ("dp2tp2", "dp2tp2+zero1")):
         base, z = by_tag.get(base_tag), by_tag.get(z_tag)
@@ -524,6 +558,110 @@ def _check_zero1_state_bytes(results: List[TargetResult]) -> None:
                 f"is not reaching the compiled artifact")
 
 
+def _check_overlap_schedule(results: List[TargetResult]) -> None:
+    """ISSUE 12 acceptance: the scheduled train.step specializations
+    must show the interleaving STRUCTURALLY in the compiled artifact.
+
+    Per overlap row, against the SAME OverlapPlan the step builds
+    (recomputed here from the audit config, so the pin can never drift
+    from the runtime's bucket math):
+
+    - the per-bucket granularity is real: reduce-scatter (or, quantized,
+      all-to-all) op count == layer groups + aux buckets, and all-gather
+      count covers the per-bucket gather units — not one fused sweep;
+    - the wire is unchanged: the overlap plan's comm_bytes_per_reduce
+      equals the eager plan's (regrouping moves no gradient bytes);
+    - the schedule interleaves: >= groups-1 gaps between consecutive
+      reduce ops carry >= 2 heavy compute ops (the next group's backward
+      layer scans) — the eager row reduces everything after ONE
+      monolithic backward, so its reduce ops cannot show this pattern at
+      group granularity;
+    - async pairs: an honest, MEASURED 0 on this CPU backend (no async
+      collectives); on an async backend (TPU) the same rows must show
+      -start/-done pairs with compute between them instead.
+    """
+    import jax
+
+    from megatron_llm_tpu.models import LlamaModel
+    from megatron_llm_tpu.optimizer.zero1 import (
+        build_overlap_plan,
+        build_zero1_plan,
+    )
+
+    by_tag = {r.mesh_tag: r for r in results if r.contract == "train.step"}
+    cfg = _audit_train_config(num_layers=4)  # the overlap rows' config
+    tmpl = jax.eval_shape(LlamaModel(cfg).init, jax.random.key(0))
+
+    for z_tag, wire_op in (("dp2+zero1+overlap", "reduce-scatter"),
+                           ("dp2+zero1-quant+overlap", "all-to-all")):
+        row = by_tag.get(z_tag)
+        if row is None:
+            continue
+        dp, _tp = _mesh_shape_for_tag(z_tag)
+        plan = build_overlap_plan(cfg, tmpl, dp, bucket_mb=0.05)
+        eager_plan = build_zero1_plan(cfg, tmpl, dp, bucket_mb=4.0)
+        quant = "-quant" in z_tag
+        n_groups = len(plan.groups)
+        n_buckets = n_groups + len([b for b in plan.aux.buckets if b])
+        rep = row.facts.get("overlap") or {}
+        counts = rep.get("collective_counts", {})
+        row.facts["overlap_plan"] = {
+            "groups": n_groups, "buckets": n_buckets,
+            "comm_bytes": plan.comm_bytes_per_reduce(quant),
+            "eager_comm_bytes": eager_plan.comm_bytes_per_reduce(quant),
+        }
+        # the fp gradient PAYLOAD must be exactly the eager plan's —
+        # regrouping moves no data bytes. The quantized totals may
+        # differ only in per-bucket chunk-scale PADDING (each bucket
+        # pads to dp x QUANT_CHUNK elements independently): bound it.
+        if plan.comm_bytes_per_reduce(False) != \
+                eager_plan.comm_bytes_per_reduce(False):
+            row.fail(
+                f"overlap regrouping changed the fp gradient wire "
+                f"bytes: {plan.comm_bytes_per_reduce(False)} vs eager "
+                f"{eager_plan.comm_bytes_per_reduce(False)} — the "
+                f"sharded/residue split drifted between the plans")
+        if quant:
+            n_eager = len([b for b in eager_plan.buckets if b])
+            pad_bound = (n_buckets + n_eager) * dp * 4
+            delta = abs(plan.comm_bytes_per_reduce(True)
+                        - eager_plan.comm_bytes_per_reduce(True))
+            if delta > pad_bound:
+                row.fail(
+                    f"quantized wire bytes differ by {delta} (> the "
+                    f"{pad_bound}-byte chunk-padding bound): the int8 "
+                    f"payload itself changed, not just scale padding")
+        if rep.get("async_pairs"):
+            # async backend: the real evidence — pairs with compute
+            # between start and done
+            if (rep.get("min_ops_between_pairs") or 0) < 1:
+                row.fail(
+                    f"async collective pairs present but at least one "
+                    f"pair has NO compute between -start and -done "
+                    f"({rep}) — the scheduler serialized the wire")
+            continue
+        # sync (CPU) backend: structural interleave of the scheduled
+        # module. Quantized buckets exchange data+scales = 2 all-to-all
+        # per issue point; fp buckets are 1 reduce-scatter each.
+        per_bucket = 2 if quant else 1
+        want = n_buckets * per_bucket
+        got = counts.get(wire_op, 0)
+        if got != want:
+            row.fail(
+                f"{wire_op} count {got} != {want} (= {n_buckets} "
+                f"buckets x {per_bucket}): the per-bucket issue points "
+                f"did not survive to the compiled schedule")
+        gaps = rep.get("compute_between", {}).get(wire_op, [])
+        deep = sum(1 for g in gaps if g >= 2)
+        row.facts["overlap_interleaved_gaps"] = deep
+        if deep < n_groups - 1:
+            row.fail(
+                f"only {deep} of the {wire_op} gaps carry >= 2 heavy "
+                f"compute ops (need >= {n_groups - 1} = group "
+                f"boundaries; gaps: {gaps}) — the backward-interleaved "
+                f"issue points collapsed into a post-backward clump")
+
+
 def audit_repo(root: str) -> dict:
     """Run the full audit: lower every reference target, check marker
     consistency, and return a JSON-able report. Requires >= 4 devices
@@ -539,6 +677,7 @@ def audit_repo(root: str) -> dict:
     # pure-dp mesh; the quantized variant's all-to-all) and the
     # dp-sharded optimizer-state args bytes below.
     for tag in ("tp2", "dp2", "dp2+zero1", "dp2+zero1-quant",
+                "dp2+zero1+overlap", "dp2+zero1-quant+overlap",
                 "dp2tp2", "dp2tp2+zero1"):
         dp, tp = _mesh_shape_for_tag(tag)
         if dp * tp > n_dev:
@@ -549,6 +688,7 @@ def audit_repo(root: str) -> dict:
             continue
         results.append(_audit_train_step(tag))
     _check_zero1_state_bytes(results)
+    _check_overlap_schedule(results)
     results.append(_audit_generate_tokens())
     results.append(_audit_chunk_topk())
     results.append(_audit_flash_attention())

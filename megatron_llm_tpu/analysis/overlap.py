@@ -1,0 +1,207 @@
+"""HLO collective-overlap introspection (ISSUE 12).
+
+The overlap scheduling work (--overlap_grad_reduce /
+--overlap_param_gather / --async_pipeline_dispatch) makes a claim about
+the COMPILED schedule: collectives run concurrently with compute. This
+module measures that claim from post-optimization HLO text the same way
+the audit reads collective inventories — from the artifact, never from
+the source.
+
+Two layers of evidence, because the two backends give different
+visibility:
+
+1. **Async pairs** (`-start`/`-done`): a backend with asynchronous
+   collectives (TPU; GPU) splits each overlapped collective into a
+   start/done pair and the scheduler moves compute between them. We
+   parse the pairs, count the compute ops scheduled between each
+   start and its done, and track the maximum number of simultaneously
+   in-flight collectives. This XLA build's CPU backend emits NO async
+   collectives (every collective is one synchronous op) — on CPU the
+   pair count is a MEASURED 0, which is what the MULTICHIP rows'
+   `async_collective_pairs` now reports (previously an honest-0
+   placeholder, now an honest-0 measurement on CPU and a real count
+   the moment the same row runs on TPU).
+
+2. **Schedule interleaving of sync collectives**: post-optimization
+   CPU modules are scheduled (`is_scheduled=true` — textual order IS
+   execution order), so even without async pairs we can pin the
+   STRUCTURAL property the TPU scheduler needs: collectives
+   interleaved with heavy compute instead of clumped after it. For
+   the backward-interleaved reduce-scatter the signature is while-ops
+   (the per-group backward layer scans) BETWEEN consecutive
+   reduce-scatters; the eager path reduces everything after the one
+   monolithic backward, so its reduce-scatters sit in a compute-free
+   clump. graft-check pins exactly this contrast
+   (analysis/audit.py `_check_overlap_schedule`).
+
+Heavy ops are `while` (the layer-scan loops — forward, backward, and
+remat recompute all live in them), `dot`, and `convolution` — data
+movement (copies, bitcasts, packing/unpacking fusions, elementwise
+optimizer fusions) is deliberately NOT counted, so the reshapes between
+two collectives do not masquerade as hidden compute.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "CollectiveOverlapReport",
+    "collective_overlap_report",
+    "parse_computations",
+]
+
+# collective opcode families, sync and async forms
+COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+_NAME_RE = re.compile(r"^\s*(?:ROOT\s+)?%(?P<name>[^\s=]+)\s*=")
+# the opcode token is the one immediately followed by the operand list;
+# matching it directly (instead of splitting name/type/opcode) survives
+# tuple-typed async ops and TPU layout annotations inside shapes
+_COLL_RE = re.compile(
+    r"\b(?P<kind>" + "|".join(re.escape(c) for c in COLLECTIVES)
+    + r")(?P<form>-start|-done)?\(")
+_HEAVY_RE = re.compile(r"\b(?:while|dot|convolution)\(")
+# computation headers: `%name (params) -> type {` / `ENTRY %name ...`.
+# The param list may contain TUPLE-typed params (while-loop body/cond
+# regions: `(arg_tuple.9: (s32[], f32[4,4]))`), so the name is matched
+# up to the first paren and the `->`/trailing `{` are checked
+# separately — a `[^)]*\)` param matcher would stop at the inner tuple
+# and silently drop exactly the computations that carry the scan
+# collectives.
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[^\s(]+)\s*\(")
+_OPERAND_RE = re.compile(r"%([^\s,)]+)")
+
+
+@dataclass
+class _Instr:
+    name: str
+    kind: Optional[str]   # collective family, or None
+    form: Optional[str]   # "sync" | "start" | "done" | None
+    heavy: bool
+    operands: Tuple[str, ...]
+
+
+@dataclass
+class CollectiveOverlapReport:
+    """What the schedule says about collective/compute concurrency."""
+
+    # async evidence (-start/-done): pair count, max simultaneously
+    # in-flight, and per-pair compute ops between start and done
+    async_pairs: int = 0
+    max_in_flight: int = 0
+    ops_between_pairs: List[int] = field(default_factory=list)
+    # sync evidence: per collective kind, op count and the number of
+    # heavy ops scheduled between consecutive ops of that kind
+    collective_counts: Dict[str, int] = field(default_factory=dict)
+    compute_between: Dict[str, List[int]] = field(default_factory=dict)
+
+    @property
+    def min_ops_between_pairs(self) -> Optional[int]:
+        return min(self.ops_between_pairs) if self.ops_between_pairs \
+            else None
+
+    def interleaved(self, kind: str) -> bool:
+        """>= 1 heavy compute op scheduled between two collectives of
+        `kind` — the sync-schedule witness of per-bucket issue points
+        threaded through the backward."""
+        return any(n > 0 for n in self.compute_between.get(kind, []))
+
+    def to_dict(self) -> dict:
+        return {
+            "async_pairs": self.async_pairs,
+            "max_in_flight": self.max_in_flight,
+            "min_ops_between_pairs": self.min_ops_between_pairs,
+            "collective_counts": dict(self.collective_counts),
+            "compute_between": {k: list(v)
+                                for k, v in self.compute_between.items()},
+        }
+
+
+def parse_computations(hlo_text: str) -> Dict[str, List[_Instr]]:
+    """Split post-optimization HLO text into computations, each a list
+    of instructions in textual = scheduled order (post-optimization
+    modules carry `is_scheduled=true`)."""
+    comps: Dict[str, List[_Instr]] = {}
+    cur: Optional[str] = None
+    for line in hlo_text.splitlines():
+        if not line.strip():
+            continue
+        if not line.startswith((" ", "\t", "}")):
+            m = _COMP_RE.match(line.strip())
+            if m and "->" in line and line.rstrip().endswith("{"):
+                cur = m.group("name")
+                comps[cur] = []
+            else:
+                # an unrecognized top-level line (module header etc.)
+                # must CLOSE the current computation — otherwise the
+                # next computation's instructions would be misattributed
+                # to the previous one and gaps counted across bodies
+                cur = None
+            continue
+        if cur is None:
+            continue
+        nm = _NAME_RE.match(line)
+        if not nm:
+            continue
+        # only the FIRST collective token followed by "(" is the opcode;
+        # operand names like %all-gather-start.1 are never followed by a
+        # paren, and metadata op_name strings use underscores
+        cm = _COLL_RE.search(line)
+        kind = form = None
+        if cm:
+            kind = cm.group("kind")
+            form = {"-start": "start", "-done": "done",
+                    None: "sync"}[cm.group("form")]
+        rest = line[nm.end():]
+        comps[cur].append(_Instr(
+            name=nm.group("name"),
+            kind=kind,
+            form=form,
+            heavy=kind is None and bool(_HEAVY_RE.search(line)),
+            operands=tuple(_OPERAND_RE.findall(rest)),
+        ))
+    return comps
+
+
+def collective_overlap_report(hlo_text: str) -> CollectiveOverlapReport:
+    """Measure collective/compute concurrency evidence across every
+    computation of a scheduled post-optimization HLO module."""
+    rep = CollectiveOverlapReport()
+    for instrs in parse_computations(hlo_text).values():
+        open_starts: Dict[str, int] = {}  # name -> heavy ops since start
+        last_sync_pos: Dict[str, int] = {}  # kind -> heavy ops seen at
+        heavy_seen = 0
+        for ins in instrs:
+            if ins.heavy:
+                heavy_seen += 1
+                for k in open_starts:
+                    open_starts[k] += 1
+            if ins.kind is None:
+                continue
+            if ins.form == "start":
+                open_starts[ins.name] = 0
+                rep.async_pairs += 1
+                rep.max_in_flight = max(rep.max_in_flight,
+                                        len(open_starts))
+            elif ins.form == "done":
+                for op in ins.operands:
+                    if op in open_starts:
+                        rep.ops_between_pairs.append(open_starts.pop(op))
+                        break
+            else:  # sync collective
+                rep.collective_counts[ins.kind] = \
+                    rep.collective_counts.get(ins.kind, 0) + 1
+                if ins.kind in last_sync_pos:
+                    rep.compute_between.setdefault(ins.kind, []).append(
+                        heavy_seen - last_sync_pos[ins.kind])
+                last_sync_pos[ins.kind] = heavy_seen
+    return rep

@@ -378,6 +378,14 @@ def build_base_parser() -> argparse.ArgumentParser:
     # (pure-dp meshes; default OFF, fp path bitwise-unchanged)
     g.add_argument("--grad_rs_bucket_mb", type=float, default=4.0)
     g.add_argument("--quantized_grad_reduce", action="store_true")
+    # collective overlap scheduling (ISSUE 12, docs/GUIDE.md
+    # "Collective overlap scheduling"): backward-interleaved grad
+    # reduce-scatter, per-bucket first-needed param all-gather, and the
+    # pp stage-ring's async double-buffered tick dispatch. All default
+    # OFF — the eager schedules stay the bitwise oracles.
+    g.add_argument("--overlap_grad_reduce", action="store_true")
+    g.add_argument("--overlap_param_gather", action="store_true")
+    g.add_argument("--async_pipeline_dispatch", action="store_true")
     g.add_argument("--data_parallel_size", type=int, default=None)
     # context parallelism (ring attention over the sequence axis) — a
     # beyond-reference long-context axis; see ParallelConfig.
@@ -591,6 +599,9 @@ def args_to_configs(args, padded_vocab_size: int):
         use_distributed_optimizer=args.use_distributed_optimizer,
         grad_rs_bucket_mb=args.grad_rs_bucket_mb,
         quantized_grad_reduce=args.quantized_grad_reduce,
+        overlap_grad_reduce=args.overlap_grad_reduce,
+        overlap_param_gather=args.overlap_param_gather,
+        async_pipeline_dispatch=args.async_pipeline_dispatch,
         num_microbatches=num_micro,
         pipeline_remat=args.pipeline_remat,
     )

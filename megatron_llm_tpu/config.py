@@ -290,6 +290,34 @@ class ParallelConfig:
     # is bitwise-unchanged; drift is measured in bench extra.zero1, not
     # assumed. Requires use_distributed_optimizer on a pure-dp mesh.
     quantized_grad_reduce: bool = False
+    # Collective overlap scheduling (ISSUE 12). Both default OFF: the
+    # eager explicit path stays the bitwise oracle.
+    # --overlap_grad_reduce: the explicit path's backward runs in layer
+    # GROUPS (sized by grad_rs_bucket_mb) and issues each group's
+    # psum_scatter the moment its cotangents materialize — group N's
+    # collective is consumed only after group N-1's backward is emitted
+    # (double-buffered), so the latency-hiding scheduler can overlap
+    # comm with the remaining backward compute. Requires the explicit
+    # ZeRO-1 path (zero1 on a pure-dp mesh, GPT-family model); the m/v
+    # layout follows the grads to a within-layer shard axis
+    # (parallel/sharding.py zero1_axis skip_leading).
+    overlap_grad_reduce: bool = False
+    # --overlap_param_gather: the param reassembly after the sharded
+    # Adam update becomes explicit per-bucket all-gathers issued
+    # first-needed-first (embedding, then layer groups in forward
+    # order), double-buffered like the reduce-scatters, instead of one
+    # GSPMD whole-tree constraint. Same explicit-path requirements;
+    # composes with either grad-reduce path and with
+    # quantized_grad_reduce.
+    overlap_param_gather: bool = False
+    # --async_pipeline_dispatch (pp>1): decouple the stage-ring ppermute
+    # from the lockstep tick — the boundary send for tick T is issued in
+    # tick T+1's body, data-independent of that tick's stage compute
+    # (double-buffered carry; each hop takes 2 ticks, fill/drain grows
+    # to 2(pp-1) ticks). Moves toward the MPMD paper's async
+    # point-to-point dispatch while keeping the scan-transpose backward
+    # (parallel/pipeline.py).
+    async_pipeline_dispatch: bool = False
     # Number of microbatches for pipelining / gradient accumulation.
     num_microbatches: int = 1
     # Pipeline backward rematerialization policy — the memory/FLOP trade
@@ -354,6 +382,37 @@ class ParallelConfig:
                     "quantized_grad_reduce with data_parallel_size=1: "
                     "there is no dp gradient reduction to quantize"
                 )
+        for flag in ("overlap_grad_reduce", "overlap_param_gather"):
+            if not getattr(self, flag):
+                continue
+            # same construction-time gate as quantized_grad_reduce: the
+            # overlap scheduling lives inside the explicit decomposition
+            # — anywhere else the flag would silently do nothing.
+            if not self.use_distributed_optimizer:
+                raise ValueError(
+                    f"{flag} requires use_distributed_optimizer: the "
+                    f"overlap scheduling reorders the ZeRO-1 explicit "
+                    f"reduce-scatter/all-gather decomposition "
+                    f"(optimizer/zero1.py); without it there is nothing "
+                    f"to schedule")
+            if (self.tensor_parallel_size > 1
+                    or self.pipeline_parallel_size > 1
+                    or self.context_parallel_size > 1):
+                raise ValueError(
+                    f"{flag} is only available on pure-dp meshes "
+                    f"(tp=pp=cp=1): the explicit path runs the fwd/bwd "
+                    f"inside a data-manual shard_map, which cannot nest "
+                    f"inside the tp/pp/cp programs on this XLA build "
+                    f"(docs/GUIDE.md, 'Collective overlap scheduling')")
+            if self.data_parallel_size <= 1:
+                raise ValueError(
+                    f"{flag} with data_parallel_size=1: there is no dp "
+                    f"collective to overlap")
+        if self.async_pipeline_dispatch and self.pipeline_parallel_size <= 1:
+            raise ValueError(
+                "async_pipeline_dispatch requires pipeline_parallel_size "
+                "> 1: it reschedules the stage-ring ppermute "
+                "(parallel/pipeline.py); there is no ring at pp=1")
 
     @property
     def resolved_pipeline_remat(self) -> str:

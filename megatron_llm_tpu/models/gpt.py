@@ -94,16 +94,92 @@ class GPTModel:
         optimizer/zero1.py, differentiates num/max(global_den, 1) to
         get the identical backward cotangent). The masked form uses the
         exact expressions of `loss`; the unmasked denominator is the
-        token count."""
-        hidden, _ = language_model_forward(
-            params, self.cfg, tokens, position_ids, attention_mask,
-            dropout_rng, deterministic, return_hidden=True,
+        token count.
+
+        Implemented AS the composition of `loss_pieces` with one
+        full-range layer group — the factored pieces are the single
+        source of the op chain, so the backward-interleaved overlap
+        path (which vjps the pieces group by group) can never drift
+        from this function."""
+        embed_fn, group_fn, head_fn = self.loss_pieces(
+            tokens, labels, loss_mask, position_ids, attention_mask,
+            dropout_rng, deterministic,
         )
-        losses = chunked_head_cross_entropy(params, self.cfg, hidden, labels)
-        if loss_mask is None:
-            return jnp.sum(losses), jnp.float32(losses.size)
-        loss_mask = loss_mask.astype(jnp.float32)
-        return jnp.sum(losses * loss_mask), jnp.sum(loss_mask)
+        aux_params = {k: v for k, v in params.items() if k != "layers"}
+        hidden = group_fn(params["layers"], embed_fn(aux_params), 0)
+        return head_fn(aux_params, hidden)
+
+    def loss_pieces(
+        self,
+        tokens: jnp.ndarray,
+        labels: jnp.ndarray,
+        loss_mask: Optional[jnp.ndarray] = None,
+        position_ids: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        dropout_rng=None,
+        deterministic: bool = True,
+    ):
+        """`loss_terms` factored at layer-group boundaries so a caller
+        can run the backward group by group and issue each group's
+        gradient collective as its cotangents materialize (the
+        backward-interleaved ZeRO-1 reduce-scatter, optimizer/zero1.py,
+        ISSUE 12). Returns
+
+          (embed_fn(aux_params) -> hidden0,
+           group_fn(layer_slice, hidden, layer_offset) -> hidden,
+           head_fn(aux_params, hidden) -> (numerator, denominator))
+
+        where `aux_params` is the params dict WITHOUT "layers" and
+        `layer_slice` is a contiguous [lo:hi] slice of the stacked
+        layer tree. Composing the pieces reproduces `loss_terms`'s
+        exact op chain — same rope table, same emb/stack dropout-rng
+        split, same per-layer fold_in keys via `layer_offset`, same
+        head/CE expressions — so vjp-by-pieces is the SAME backward
+        ops as value_and_grad of `loss_terms` (fp32 bitwise; pinned in
+        tests/test_overlap.py)."""
+        from megatron_llm_tpu.models.language_model import (
+            chunked_head_cross_entropy,
+            embed_tokens,
+        )
+        from megatron_llm_tpu.models.norms import apply_norm
+        from megatron_llm_tpu.models.rope import precompute_rope
+        from megatron_llm_tpu.models.transformer import transformer_stack
+
+        cfg = self.cfg
+        if cfg.position_embedding_type == "rotary":
+            rope_table = precompute_rope(
+                cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta,
+                cfg.rope_scaling_factor,
+            )
+        else:
+            rope_table = None
+        if dropout_rng is not None:
+            emb_rng, stack_rng = jax.random.split(dropout_rng)
+        else:
+            emb_rng = stack_rng = None
+
+        def embed_fn(aux_params):
+            return embed_tokens(aux_params, cfg, tokens, position_ids,
+                                emb_rng, deterministic)
+
+        def group_fn(layer_slice, hidden, layer_offset):
+            out, _ = transformer_stack(
+                layer_slice, cfg, hidden, rope_table, attention_mask,
+                position_ids, stack_rng, deterministic,
+                layer_offset=layer_offset,
+            )
+            return out
+
+        def head_fn(aux_params, hidden):
+            hidden = apply_norm(hidden, aux_params["final_norm"], cfg)
+            losses = chunked_head_cross_entropy(aux_params, cfg, hidden,
+                                                labels)
+            if loss_mask is None:
+                return jnp.sum(losses), jnp.float32(losses.size)
+            lm = loss_mask.astype(jnp.float32)
+            return jnp.sum(losses * lm), jnp.sum(lm)
+
+        return embed_fn, group_fn, head_fn
 
     def loss_denominator(self, tokens=None, labels=None, loss_mask=None,
                          **_) -> jnp.ndarray:

@@ -162,6 +162,28 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
     cfg = model.cfg
     mesh = ctx.mesh
     num_stages = pcfg.pipeline_parallel_size
+    # Async tick dispatch (--async_pipeline_dispatch, ISSUE 12): the
+    # stage-ring ppermute decouples from the lockstep tick. The lockstep
+    # body is compute -> permute -> carry: the permute's result feeds
+    # the very next tick's compute, so XLA must serialize wire and MXU.
+    # Async double-buffers the carry: tick T's body issues the permute
+    # of tick T-1's OUTPUT (`fly`), which nothing in tick T's compute
+    # consumes — the collective-permute and the stage compute are
+    # data-independent inside one scan body, exactly what the
+    # latency-hiding scheduler needs to overlap them (the MPMD paper's
+    # async point-to-point dispatch, still inside the scan-transpose
+    # backward — AD of the delayed carry is the same delay in reverse,
+    # so the backward ring overlaps too). The price is schedule depth:
+    # each hop takes 2 ticks, so fill/drain grows from pp-1 to
+    # 2(pp-1) ticks — at num_micro >> pp the bubble cost is small and
+    # the per-tick wire hides; at tiny num_micro lockstep wins
+    # (docs/GUIDE.md "Collective overlap scheduling"). Per-microbatch
+    # math is IDENTICAL (deterministic runs bitwise vs lockstep,
+    # tests/test_overlap.py); with dropout the per-tick rng keys map to
+    # different ticks — a different but equally valid stream, like the
+    # zero1 per-rank dropout note.
+    async_dispatch = getattr(pcfg, "async_pipeline_dispatch", False)
+    hop = 2 if async_dispatch else 1
     # Context parallelism inside the pipeline: `context` joins `stage` as a
     # manual axis of the SAME shard_map (Shardy rejects a nested manual
     # region whose operands mix free `stage` with manual `context`), the
@@ -225,7 +247,9 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
         def _stack_shard_body(layers_local, aux, toks, lbls, lmask, pids,
                               rope):
             stage = jax.lax.axis_index(STAGE_AXIS)
-            total = num_micro + num_stages - 1
+            # async dispatch: each hop takes `hop` ticks (one in-flight
+            # slot per boundary), so fill/drain stretches accordingly
+            total = num_micro + hop * (num_stages - 1)
             manual_axes, aux, rope, (toks, lbls, lmask, pids), \
                 layers_local = _mark_varying(
                     cp, aux, rope, (toks, lbls, lmask, pids), layers_local
@@ -252,7 +276,10 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 return jnp.sum(losses * lm_t), jnp.sum(lm_t)
 
             def tick(carry, t):
-                state, sums, denoms = carry
+                if async_dispatch:
+                    state, fly, sums, denoms = carry
+                else:
+                    state, sums, denoms = carry
                 m_in = jnp.clip(t, 0, num_micro - 1)
                 toks_t = jax.lax.dynamic_index_in_dim(toks, m_in, 0, False)
                 pids_t = jax.lax.dynamic_index_in_dim(pids, m_in, 0, False)
@@ -280,8 +307,10 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
 
                 # last stage runs head + CE for the microbatch leaving the
                 # pipe this tick; other stages skip the head FLOPs entirely
-                m_out = jnp.clip(t - (num_stages - 1), 0, num_micro - 1)
-                valid = (stage == num_stages - 1) & (t >= num_stages - 1)
+                m_out = jnp.clip(t - hop * (num_stages - 1), 0,
+                                 num_micro - 1)
+                valid = (stage == num_stages - 1) & \
+                    (t >= hop * (num_stages - 1))
                 lbl_t = jax.lax.dynamic_index_in_dim(lbls, m_out, 0, False)
                 lm_t = jax.lax.dynamic_index_in_dim(lmask, m_out, 0, False)
                 zero = _pcast(
@@ -320,10 +349,16 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 # rotate stage s -> s+1 (ref: send_forward
                 # p2p_communication.py:292; backward of this ppermute is the
                 # reverse rotation = send_backward :311)
-                state = jax.lax.ppermute(
-                    out, STAGE_AXIS,
-                    [(i, i + 1) for i in range(num_stages - 1)],
-                )
+                ring = [(i, i + 1) for i in range(num_stages - 1)]
+                if async_dispatch:
+                    # the DELAYED send: permute last tick's output
+                    # (`fly`), which this tick's compute never touches —
+                    # wire and MXU are independent inside the body, so
+                    # the scheduler can run them concurrently; `out`
+                    # rides the carry to be sent next tick
+                    arrived = jax.lax.ppermute(fly, STAGE_AXIS, ring)
+                    return (arrived, out, sums, denoms), None
+                state = jax.lax.ppermute(out, STAGE_AXIS, ring)
                 return (state, sums, denoms), None
 
             # Backward memory policy (ParallelConfig.pipeline_remat) —
@@ -355,9 +390,20 @@ def make_pipelined_loss_fn(model, pcfg, ctx: ParallelContext):
                 jnp.zeros((num_micro,), jnp.float32), (STAGE_AXIS,),
                 to="varying",
             )
-            (_, sums, denoms), _ = jax.lax.scan(
-                tick, (state, sums0, denoms0), jnp.arange(total)
-            )
+            if async_dispatch:
+                fly0 = _pcast(
+                    jnp.zeros((b, s // cp, cfg.hidden_size),
+                              boundary_dtype),
+                    manual_axes, to="varying",
+                )
+                (_, _, sums, denoms), _ = jax.lax.scan(
+                    tick, (state, fly0, sums0, denoms0),
+                    jnp.arange(total)
+                )
+            else:
+                (_, sums, denoms), _ = jax.lax.scan(
+                    tick, (state, sums0, denoms0), jnp.arange(total)
+                )
             # leading stage axis: only the last stage's row is meaningful;
             # the caller slices [-1], one scalar-row transfer from the last
             # stage (the analogue of the last->first stage loss broadcast,

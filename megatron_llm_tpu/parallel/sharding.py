@@ -110,21 +110,37 @@ def param_shardings(ctx: ParallelContext, cfg, params: dict) -> dict:
     )
 
 
-def zero1_axis(spec: P, shape: tuple, dp: int) -> Optional[int]:
+def zero1_axis(spec: P, shape: tuple, dp: int,
+               skip_leading: bool = False) -> Optional[int]:
     """The leaf axis ZeRO-1 shards over `data`: the first free axis
     divisible by dp, or None when no such axis exists (the replicated
     residue — see zero1_spec). The ONE divisibility rule: zero1_spec,
     the explicit reduce-scatter plan (optimizer/zero1.py), and the audit
     all derive from this so they can never disagree on which leaves are
-    sharded."""
+    sharded.
+
+    `skip_leading` (the --overlap_grad_reduce layout, ISSUE 12): never
+    pick axis 0. Stacked (L, ...) layer leaves must shard WITHIN a
+    layer for the backward-interleaved reduce-scatter — a layer group's
+    psum_scatter can only deliver rank r a same-position block of every
+    rank's slice, so sharding the layer axis would interleave shard
+    ownership across groups and break the contiguous zero1_spec layout
+    the m/v trees are stored in. Skipping axis 0 makes every group's
+    scatter land exactly on rows [lo:hi) of the rank's shard. A leaf
+    whose ONLY dp-divisible axis is the leading one falls to the
+    replicated residue under this rule (its optimizer state replicates
+    — the same trade zero1_spec documents for norm scales)."""
     parts = list(spec) + [None] * (len(shape) - len(spec))
     for i, (p, n) in enumerate(zip(parts, shape)):
+        if skip_leading and i == 0:
+            continue
         if p is None and n % dp == 0 and n >= dp:
             return i
     return None
 
 
-def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
+def zero1_spec(spec: P, shape: tuple, dp: int,
+               skip_leading: bool = False) -> P:
     """Add the `data` axis to the first free axis divisible by dp — the
     GSPMD form of the reference's flat-buffer range sharding
     (ref: distrib_optimizer.py:63-116). Unlike the reference, shards respect
@@ -138,7 +154,7 @@ def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
     Llama-2-7B at dp=8: ~0.9 MB replicated vs ~3.4 GB/device sharded
     moments (<0.03%). The trade buys per-leaf resharding on restore (the
     checkpoint is mesh-shape-free) and no gather/scatter bookkeeping."""
-    k = zero1_axis(spec, shape, dp)
+    k = zero1_axis(spec, shape, dp, skip_leading=skip_leading)
     if k is None:
         return spec
     parts = list(spec) + [None] * (len(shape) - len(spec))
@@ -146,20 +162,37 @@ def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
     return P(*parts)
 
 
+def _under_layer_stack(path) -> bool:
+    """Whether a tree path points inside a stacked-layer subtree (the
+    leaves whose leading axis is the layer axis)."""
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key in ("layers", "decoder_layers"):
+            return True
+    return False
+
+
 def optimizer_state_specs(cfg, params: dict, dp: int, distributed: bool,
-                          base_specs: Any = None) -> Any:
+                          base_specs: Any = None,
+                          overlap_grads: bool = False) -> Any:
     """Specs for one params-shaped moment tree (m or v). `base_specs`
     overrides the default param specs (e.g. the pipeline variant with the
-    layer axis on `stage`)."""
+    layer axis on `stage`). `overlap_grads` (--overlap_grad_reduce,
+    ISSUE 12) applies the skip-leading rule to stacked-layer leaves so
+    the m/v layout matches the grads the backward-interleaved
+    reduce-scatter delivers (see zero1_axis)."""
     specs = base_specs if base_specs is not None else param_specs(cfg, params)
     if not distributed or dp <= 1:
         return specs
-    flat_params = jax.tree.leaves(params)
+    flat_params, _ = jax.tree_util.tree_flatten_with_path(params)
     flat_specs, treedef = jax.tree.flatten(
         specs, is_leaf=lambda x: isinstance(x, P)
     )
     out = [
-        zero1_spec(s, p.shape, dp) for s, p in zip(flat_specs, flat_params)
+        zero1_spec(
+            s, p.shape, dp,
+            skip_leading=overlap_grads and _under_layer_stack(path))
+        for s, (path, p) in zip(flat_specs, flat_params)
     ]
     return jax.tree.unflatten(treedef, out)
 

@@ -265,6 +265,9 @@ class Trainer:
             ospecs = optimizer_state_specs(
                 self.cfg, tmpl, self.pcfg.data_parallel_size,
                 self.pcfg.use_distributed_optimizer, base_specs=pspecs,
+                # m/v follow the grad layout: --overlap_grad_reduce
+                # shards stacked-layer leaves within a layer (ISSUE 12)
+                overlap_grads=self.pcfg.overlap_grad_reduce,
             )
             osh = jax.tree.map(lambda s: NamedSharding(mesh, s), ospecs,
                                is_leaf=lambda x: isinstance(x, P))
@@ -363,6 +366,7 @@ class Trainer:
             # and the analytic dp gradient-wire bytes per step — the
             # numbers the llama7b-v5p64 sizing math is made of.
             from megatron_llm_tpu.optimizer.zero1 import (
+                build_overlap_plan,
                 build_zero1_plan,
                 explicit_zero1_supported,
             )
@@ -375,6 +379,12 @@ class Trainer:
                 else "gspmd-spec")
             if self.pcfg.quantized_grad_reduce:
                 facts["zero1-quantized-reduce"] = True
+            overlap_on = [
+                n for n, f in (("grads", self.pcfg.overlap_grad_reduce),
+                               ("gather", self.pcfg.overlap_param_gather))
+                if f]
+            if overlap_on:
+                facts["zero1-overlap"] = "+".join(overlap_on)
             try:
                 per_dev = 0
                 for leaf in jax.tree.leaves(
@@ -385,7 +395,13 @@ class Trainer:
             except Exception:
                 pass
             if facts["zero1-path"] == "explicit-rs":
-                plan = build_zero1_plan(
+                # the SAME plan flavor the step built: bucket counts and
+                # per-bucket wire bytes must describe the schedule
+                # actually running (ISSUE 12)
+                build = (build_overlap_plan
+                         if self.pcfg.overlap_grad_reduce
+                         else build_zero1_plan)
+                plan = build(
                     self.cfg, lower_args[0],
                     self.pcfg.data_parallel_size,
                     bucket_mb=self.pcfg.grad_rs_bucket_mb)
@@ -399,15 +415,44 @@ class Trainer:
                     * num_micro
                     + params_bytes  # the param all-gather leg
                 )
-                facts["grad-rs-buckets"] = len(plan.buckets)
-        if self._tb_writer is not None \
-                and self.tcfg.log_memory_to_tensorboard:
+                bucket_bytes = plan.bucket_comm_bytes(
+                    self.pcfg.quantized_grad_reduce)
+                facts["grad-rs-buckets"] = len(bucket_bytes)
+                # per-issue-point wire bytes so bucket sizing can be
+                # tuned against the overlap window (--grad_rs_bucket_mb)
+                facts["grad-rs-bucket-bytes"] = list(bucket_bytes)
+        # the opt-in relower (--log_memory_to_tensorboard — it pays one
+        # extra full compile, see docstring): memory analysis rides it
+        # as before; on overlap runs the same compiled text also yields
+        # the measured `grad-comm-overlap-pairs` gauge (the async
+        # -start/-done pair count of the exact step, analysis/overlap.py
+        # — a measured 0 on backends without async collectives)
+        want_overlap_report = (
+            self.tcfg.log_memory_to_tensorboard
+            and (self.pcfg.overlap_grad_reduce
+                 or self.pcfg.overlap_param_gather))
+        want_memory = (self._tb_writer is not None
+                       and self.tcfg.log_memory_to_tensorboard)
+        if want_memory or want_overlap_report:
             try:
-                mem = step_fn.lower(*lower_args).compile().memory_analysis()
-                facts["compiled-temp-bytes"] = int(mem.temp_size_in_bytes)
-                facts["compiled-args-bytes"] = int(
-                    mem.argument_size_in_bytes
-                )
+                compiled = step_fn.lower(*lower_args).compile()
+                if want_memory:
+                    mem = compiled.memory_analysis()
+                    facts["compiled-temp-bytes"] = int(
+                        mem.temp_size_in_bytes)
+                    facts["compiled-args-bytes"] = int(
+                        mem.argument_size_in_bytes
+                    )
+                if want_overlap_report:
+                    from megatron_llm_tpu.analysis.overlap import (
+                        collective_overlap_report,
+                    )
+
+                    rep = collective_overlap_report(compiled.as_text())
+                    facts["grad-comm-overlap-pairs"] = rep.async_pairs
+                    if rep.async_pairs:
+                        facts["grad-comm-overlap-max-in-flight"] = \
+                            rep.max_in_flight
             except Exception as e:
                 print(f"step-0 memory analysis unavailable: {e}",
                       flush=True)

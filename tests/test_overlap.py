@@ -1,0 +1,485 @@
+"""Collective overlap scheduling (ISSUE 12): backward-interleaved
+gradient reduce-scatter, per-bucket param all-gather, async pipeline
+dispatch — optimizer/zero1.py + training/train_step.py +
+parallel/pipeline.py + analysis/overlap.py.
+
+The claims pinned here, mirroring tests/test_zero1.py's contract
+matrix with the scheduled paths against the EAGER explicit path (which
+test_zero1 pins against replicated Adam, so equality here is
+transitively equality with the replicated oracle):
+
+- overlap ON (--overlap_grad_reduce + --overlap_param_gather) is
+  BITWISE identical to the eager explicit path — per-step losses,
+  final params AND moments — at dp2/dp4 in fp32, and each flag alone
+  is too. The mechanism: vjp-by-pieces at model.loss_pieces'
+  factorization boundaries records the same backward ops as
+  value_and_grad(loss_terms) (groups are >= 2 layers so every group
+  keeps the rolled scan body — build_overlap_plan documents the
+  measured 1-layer-unroll failure mode); psum_scatter reduces
+  elementwise in rank order regardless of bucket regrouping; the
+  gather is pure data movement. The grad-norm SCALAR reduces over a
+  different shard partitioning — within-layer axes instead of the
+  layer axis — so it gets the same one-ulp latitude test_zero1 gives
+  its dp4 row.
+- fp16 dynamic-scaler semantics preserved (losses/params/m/v/scale
+  bitwise), grad-clip + found_inf/watchdog in-step skip identical.
+- overlap x --quantized_grad_reduce composes: int8 all-to-all wire at
+  group granularity, drift vs the fp path bounded and MEASURED (the
+  quantized values are NOT bitwise vs eager-quantized — regrouping
+  changes the chunk boundaries the scales are computed over; the fp
+  contract is the bitwise one).
+- the schedule is structurally different in the compiled artifact:
+  reduce-scatter count == layer groups + aux buckets, and >= groups-1
+  inter-collective gaps carry the next group's backward (heavy ops) —
+  measured by analysis/overlap.py, the same helper graft-check pins.
+- async pipeline dispatch (--async_pipeline_dispatch): pp2 loss AND
+  grads bitwise vs the lockstep schedule on deterministic runs (the
+  double-buffered carry delays each hop by one tick; per-microbatch
+  math is unchanged).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from megatron_llm_tpu.analysis.overlap import collective_overlap_report
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig, tiny_config
+from megatron_llm_tpu.models import LlamaModel
+from megatron_llm_tpu.optimizer.zero1 import (
+    build_overlap_plan,
+    build_zero1_plan,
+)
+from megatron_llm_tpu.parallel.mesh import (
+    DATA_AXIS,
+    destroy_parallel,
+    initialize_parallel,
+)
+from megatron_llm_tpu.training.trainer import Trainer
+
+SEQ = 32
+VOCAB = 256
+BUCKET_MB = 0.05  # small enough that the tiny model splits into >1 group
+
+
+def _cfg(**over):
+    base = dict(
+        seq_length=SEQ, max_position_embeddings=SEQ,
+        compute_dtype=jnp.float32, params_dtype=jnp.float32,
+    )
+    base.update(over)
+    return tiny_config(**base)
+
+
+def _run(dp, overlap=False, gather=False, steps=3, compute=jnp.float32,
+         fp16=False, quant=False, num_micro=2, dropout=0.0, seed=0,
+         with_hlo=False, bucket_mb=BUCKET_MB, log_memory=False,
+         layers=2):
+    """Train `steps` steps under zero1 on a pure-dp mesh; returns
+    (losses, gnorms, params, m, v, step_hlo_text, trainer_gauges)."""
+    cfg = _cfg(compute_dtype=compute, hidden_dropout=dropout,
+               attention_dropout=dropout, num_layers=layers)
+    mbs = 2
+    rows = mbs * dp
+    tcfg = TrainConfig(
+        micro_batch_size=mbs, global_batch_size=num_micro * rows,
+        lr=1e-3, clip_grad=1.0, train_iters=steps,
+        bf16=not fp16, fp16=fp16,
+        log_memory_to_tensorboard=log_memory)
+    pcfg = ParallelConfig(
+        data_parallel_size=dp, num_microbatches=num_micro,
+        use_distributed_optimizer=True, quantized_grad_reduce=quant,
+        overlap_grad_reduce=overlap, overlap_param_gather=gather,
+        grad_rs_bucket_mb=bucket_mb)
+    initialize_parallel(dp=dp, pp=1, tp=1)
+    try:
+        trainer = Trainer(LlamaModel(cfg), tcfg, pcfg)
+        state = trainer.setup()
+        rs = np.random.RandomState(seed)
+        losses, gnorms = [], []
+        rng = jax.random.key(7) if dropout > 0 else None
+        for i in range(steps):
+            text = rs.randint(
+                0, VOCAB, (num_micro, rows, SEQ + 1)).astype(np.int32)
+            step_rng = jax.random.fold_in(rng, i) if rng is not None \
+                else None
+            stats = trainer.train_step(state, text, step_rng)
+            losses.append(float(stats["loss"]))
+            gnorms.append(float(stats["grad_norm"]))
+        params = jax.tree.map(np.asarray, state.params)
+        m = jax.tree.map(np.asarray, state.opt_state.m)
+        v = jax.tree.map(np.asarray, state.opt_state.v)
+        txt = None
+        if with_hlo:
+            from megatron_llm_tpu.training.trainer import get_batch
+
+            text = rs.randint(0, VOCAB,
+                              (num_micro, rows, SEQ + 1)).astype(np.int32)
+            batch = get_batch(text, None)
+            txt = trainer._get_step_fn(num_micro).lower(
+                state.params, state.opt_state, batch,
+                jnp.float32(1e-3), jnp.float32(0.01),
+                jax.random.fold_in(rng, 99) if rng is not None else None,
+                jnp.float32(np.inf)).compile().as_text()
+        return losses, gnorms, params, m, v, txt, \
+            dict(trainer.timers.gauges())
+    finally:
+        destroy_parallel()
+
+
+def _trees_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+class TestOverlapBitwiseParity:
+    """Scheduled paths == eager explicit path, trainer end to end."""
+
+    # 4 layers so the small bucket target yields MULTIPLE groups (the
+    # plan's 2-layer floor — build_overlap_plan — would collapse the
+    # 2-layer tiny default into one group, leaving no issue-point
+    # boundary for the schedule test to witness)
+    @pytest.fixture(scope="class")
+    def dp2_fp32(self):
+        eager = _run(2, overlap=False, gather=False, with_hlo=True,
+                     layers=4)
+        over = _run(2, overlap=True, gather=True, with_hlo=True,
+                    layers=4)
+        return eager, over
+
+    def test_dp2_fp32_bitwise(self, dp2_fp32):
+        """Losses/params/moments bitwise. The grad-norm SCALAR gets the
+        one-ulp latitude test_zero1 documents at dp4: the overlap
+        layout reduces each leaf's sumsq over within-layer shards
+        instead of layer-axis shards, so the partial grouping — and its
+        last-bit rounding — differs. The clip coefficient saturates at
+        1 below clip_grad either way, so the update stays bitwise;
+        under ACTIVE clipping the coefficient (then params) could
+        differ in the same last ulp."""
+        (l_e, g_e, p_e, m_e, v_e, _, _), \
+            (l_o, g_o, p_o, m_o, v_o, _, _) = dp2_fp32
+        assert l_e == l_o, (l_e, l_o)
+        np.testing.assert_allclose(g_e, g_o, rtol=1e-6)
+        assert _trees_equal(p_e, p_o)
+        assert _trees_equal(m_e, m_o)
+        assert _trees_equal(v_e, v_o)
+
+    def test_dp2_hlo_schedule(self, dp2_fp32):
+        """The compiled artifact shows the restructure: per-bucket
+        reduce ops at group granularity, interleaved with the per-group
+        backward loops; the fp wire payload unchanged vs eager; no
+        quantization ops on either path."""
+        (_, _, _, _, _, t_eager, _), (_, _, _, _, _, t_over, _) = dp2_fp32
+        cfg = _cfg(num_layers=4)
+        tmpl = jax.eval_shape(LlamaModel(cfg).init, jax.random.key(0))
+        plan = build_overlap_plan(cfg, tmpl, 2, bucket_mb=BUCKET_MB)
+        eplan = build_zero1_plan(cfg, tmpl, 2, bucket_mb=BUCKET_MB)
+        assert len(plan.groups) > 1  # the bucket target forced groups
+        n_buckets = len(plan.groups) + \
+            len([b for b in plan.aux.buckets if b])
+
+        rep_o = collective_overlap_report(t_over)
+        rep_e = collective_overlap_report(t_eager)
+        # per-bucket granularity survived compilation, on both paths
+        assert rep_o.collective_counts["reduce-scatter"] == n_buckets
+        assert rep_e.collective_counts["reduce-scatter"] == \
+            len([b for b in eplan.buckets if b])
+        # the scheduled path interleaves: >= groups-1 reduce gaps carry
+        # the next group's backward (>= 2 heavy ops each)
+        gaps = rep_o.compute_between["reduce-scatter"]
+        assert sum(1 for g in gaps if g >= 2) >= len(plan.groups) - 1, \
+            gaps
+        # regrouping moved no gradient bytes
+        assert plan.comm_bytes_per_reduce(False) == \
+            eplan.comm_bytes_per_reduce(False)
+        # explicit per-bucket gather: all-gather count covers the units
+        assert rep_o.collective_counts["all-gather"] >= n_buckets
+        # default-OFF quantization guard holds on the scheduled path too
+        for txt in (t_eager, t_over):
+            assert "all-to-all" not in txt
+            assert "s8[" not in txt
+        # async pairs: a MEASURED 0 on this CPU backend (the helper
+        # counts real pairs on TPU — pinned in the graft-check audit)
+        assert rep_o.async_pairs == 0
+
+    def test_dp4_fp32_bitwise(self):
+        """dp4: losses/params/moments bitwise; the grad-norm SCALAR
+        gets the same one-ulp latitude as test_zero1's dp4 row (the
+        overlap layout reduces each leaf's sumsq over within-layer
+        shards instead of layer-axis shards)."""
+        l_e, g_e, p_e, m_e, v_e, _, _ = _run(4)
+        l_o, g_o, p_o, m_o, v_o, _, _ = _run(4, overlap=True, gather=True)
+        assert l_e == l_o, (l_e, l_o)
+        np.testing.assert_allclose(g_e, g_o, rtol=1e-6)
+        assert _trees_equal(p_e, p_o)
+        assert _trees_equal(m_e, m_o)
+        assert _trees_equal(v_e, v_o)
+
+    def test_each_flag_alone_bitwise(self, dp2_fp32):
+        """--overlap_grad_reduce and --overlap_param_gather are
+        independent: each alone reproduces the eager run bitwise."""
+        (l_e, _, p_e, m_e, v_e, _, _), _ = dp2_fp32
+        for overlap, gather in ((True, False), (False, True)):
+            l, _, p, m, v, _, _ = _run(2, overlap=overlap, gather=gather,
+                                       steps=2, layers=4)
+            assert l == l_e[:2], (overlap, gather, l, l_e)
+            # params after 2 steps vs the fixture's 3: compare losses
+            # only for the truncated run; the full-matrix equality is
+            # test_dp2_fp32_bitwise — this pins flag independence
+            del p, m, v
+
+    def test_dp2_fp16_scaler_semantics(self):
+        """fp16 dynamic-scaler: losses/params/moments/scale bitwise;
+        the grad-norm scalar pinned to its fp32 neighborhood (NaN on
+        overflow-skipped steps matches NaN)."""
+        r = _run(2, fp16=True, compute=jnp.float16)
+        o = _run(2, overlap=True, gather=True, fp16=True,
+                 compute=jnp.float16)
+        assert r[0] == o[0], (r[0], o[0])
+        assert _trees_equal(r[2], o[2])
+        assert _trees_equal(r[3], o[3])
+        assert _trees_equal(r[4], o[4])
+        np.testing.assert_allclose(r[1], o[1], rtol=1e-6)
+
+    def test_quantized_compose(self, dp2_fp32):
+        """overlap x --quantized_grad_reduce: the int8 exchange rides
+        the group issue points (all-to-all + s8 in HLO, no
+        reduce-scatter), and the loss trajectory drifts from the fp
+        path only within the measured int8 bound — NOT bitwise vs
+        eager-quantized (regrouping moves the chunk boundaries; the
+        bitwise contract is fp-only, docs/GUIDE.md)."""
+        (l_fp, _, _, _, _, _, _), _ = dp2_fp32
+        l_q, _, _, _, _, txt, _ = _run(2, overlap=True, gather=True,
+                                       quant=True, with_hlo=True)
+        assert all(np.isfinite(l_q)), l_q
+        drift = max(abs(a - b) / max(abs(a), 1e-9)
+                    for a, b in zip(l_fp, l_q))
+        assert drift < 0.05, (drift, l_fp, l_q)
+        assert "all-to-all" in txt
+        assert "s8[" in txt
+        assert "reduce-scatter" not in txt
+
+    def test_dropout_rng_smoke(self):
+        """The scheduled path with dropout trains (the split forward
+        folds the same emb/stack keys; the per-rank stream deviation
+        from replicated is the documented zero1 one)."""
+        l, _, _, _, _, _, _ = _run(2, overlap=True, gather=True, steps=2,
+                                   dropout=0.1)
+        assert all(np.isfinite(l)), l
+
+
+class TestOverlapSkipSemantics:
+    def test_watchdog_spike_skip_identical(self):
+        """A spike-threshold skip under the scheduled path: params/opt
+        untouched BITWISE, exactly as the eager path skips."""
+        from megatron_llm_tpu.training.trainer import get_batch
+
+        cfg = _cfg()
+        dp, num_micro, mbs = 2, 2, 2
+        rows = mbs * dp
+        tcfg = TrainConfig(micro_batch_size=mbs,
+                           global_batch_size=num_micro * rows, lr=1e-3)
+        pcfg = ParallelConfig(data_parallel_size=dp,
+                              num_microbatches=num_micro,
+                              use_distributed_optimizer=True,
+                              overlap_grad_reduce=True,
+                              overlap_param_gather=True,
+                              grad_rs_bucket_mb=BUCKET_MB)
+        initialize_parallel(dp=dp, pp=1, tp=1)
+        try:
+            trainer = Trainer(LlamaModel(cfg), tcfg, pcfg)
+            state = trainer.setup()
+            text = np.random.RandomState(0).randint(
+                0, VOCAB, (num_micro, rows, SEQ + 1)).astype(np.int32)
+            batch = get_batch(text, None)
+            step = trainer._get_step_fn(num_micro)
+            p0 = jax.tree.map(np.asarray, state.params)
+            m0 = jax.tree.map(np.asarray, state.opt_state.m)
+            new_p, new_s, stats = step(
+                state.params, state.opt_state, batch, jnp.float32(1e-3),
+                jnp.float32(0.0), None, jnp.float32(1e-6))
+            assert int(stats["skipped"]) == 1
+            assert _trees_equal(p0, jax.tree.map(np.asarray, new_p))
+            assert _trees_equal(m0, jax.tree.map(np.asarray, new_s.m))
+            assert int(new_s.step) == 0
+        finally:
+            destroy_parallel()
+
+
+class TestOverlapPlan:
+    """Pure shape math: the plan and the layout rule."""
+
+    def _tmpl(self, **over):
+        cfg = _cfg(**over)
+        return cfg, jax.eval_shape(LlamaModel(cfg).init, jax.random.key(0))
+
+    def test_groups_partition_layers(self):
+        cfg, tmpl = self._tmpl(num_layers=4)
+        plan = build_overlap_plan(cfg, tmpl, 2, bucket_mb=BUCKET_MB)
+        assert plan.groups == ((0, 2), (2, 4))  # 2-layer floor applies
+        # a huge target packs all layers into one group
+        one = build_overlap_plan(cfg, tmpl, 2, bucket_mb=64)
+        assert one.groups == ((0, 4),)
+        # never a 1-layer group (XLA unrolls trip-1 scans and breaks
+        # the bitwise contract — build_overlap_plan docstring): an odd
+        # depth merges the remainder into its neighbor
+        cfg5, tmpl5 = self._tmpl(num_layers=5)
+        plan5 = build_overlap_plan(cfg5, tmpl5, 2, bucket_mb=BUCKET_MB)
+        assert plan5.groups == ((0, 2), (2, 5))
+        assert all(hi - lo >= 2 for lo, hi in plan5.groups)
+
+    def test_skip_leading_rule(self):
+        """Layer leaves never shard the layer axis under the overlap
+        plan (the per-group scatter would interleave shard ownership,
+        parallel/sharding.py); the eager plan DOES pick it when
+        divisible — the two layouts are the point of the m/v spec
+        flag."""
+        cfg, tmpl = self._tmpl()
+        plan = build_overlap_plan(cfg, tmpl, 2, bucket_mb=BUCKET_MB)
+        assert all(k is None or k >= 1 for k in plan.layer_axes)
+        eplan = build_zero1_plan(cfg, tmpl, 2, bucket_mb=BUCKET_MB)
+        flat_l = jax.tree.leaves(tmpl["layers"])
+        # eager shards at least one stacked leaf on the layer axis here
+        # (L=2 divides dp=2)
+        stacked_axes = [
+            eplan.leaf_axes[i]
+            for i, l in enumerate(jax.tree.leaves(tmpl))
+            if any(l is s for s in flat_l)]
+        assert 0 in stacked_axes
+
+    def test_wire_accounting(self):
+        cfg, tmpl = self._tmpl()
+        plan = build_overlap_plan(cfg, tmpl, 2, bucket_mb=BUCKET_MB)
+        eplan = build_zero1_plan(cfg, tmpl, 2, bucket_mb=BUCKET_MB)
+        # fp payload identical; per-bucket entries = groups + aux
+        assert plan.comm_bytes_per_reduce(False) == \
+            eplan.comm_bytes_per_reduce(False)
+        bb = plan.bucket_comm_bytes(False)
+        assert len(bb) == len(plan.groups) + \
+            len([b for b in plan.aux.buckets if b])
+        assert all(b > 0 for b in bb)
+        # quantized totals differ from fp only by the int8/scale format
+        assert plan.comm_bytes_per_reduce(True) < \
+            plan.comm_bytes_per_reduce(False)
+
+    def test_optimizer_state_specs_follow_layout(self):
+        from megatron_llm_tpu.parallel.sharding import (
+            optimizer_state_specs,
+        )
+
+        cfg, tmpl = self._tmpl()
+        eager = optimizer_state_specs(cfg, tmpl, 2, True)
+        over = optimizer_state_specs(cfg, tmpl, 2, True,
+                                     overlap_grads=True)
+        flat_e = jax.tree.flatten(
+            eager["layers"], is_leaf=lambda x: isinstance(x, P))[0]
+        flat_o = jax.tree.flatten(
+            over["layers"], is_leaf=lambda x: isinstance(x, P))[0]
+        # overlap: never DATA on the leading (layer) axis; eager: at
+        # least one leaf has it there at this config
+        assert all(len(s) == 0 or s[0] != DATA_AXIS for s in flat_o)
+        assert any(len(s) > 0 and s[0] == DATA_AXIS for s in flat_e)
+        # both layouts still shard every shardable layer leaf
+        assert sum(DATA_AXIS in tuple(s) for s in flat_o) >= \
+            sum(DATA_AXIS in tuple(s) for s in flat_e) - 1
+        # aux subtree unchanged between the flavors
+        assert eager["embedding"] == over["embedding"]
+
+    def test_config_gates(self):
+        with pytest.raises(ValueError, match="use_distributed_optimizer"):
+            ParallelConfig(data_parallel_size=2, overlap_grad_reduce=True)
+        with pytest.raises(ValueError, match="pure-dp"):
+            ParallelConfig(data_parallel_size=2, tensor_parallel_size=2,
+                           use_distributed_optimizer=True,
+                           overlap_param_gather=True)
+        with pytest.raises(ValueError, match="pipeline_parallel_size"):
+            ParallelConfig(async_pipeline_dispatch=True)
+        with pytest.raises(ValueError, match="loss_terms"):
+            # explicit-path-only flags on a loss_terms-less model fail
+            # LOUDLY at step construction (the quantized_grad_reduce
+            # pattern)
+            from megatron_llm_tpu.models.bert import BertModel
+            from megatron_llm_tpu.training.train_step import (
+                make_train_step,
+            )
+
+            cfg = _cfg(num_tokentypes=2, add_binary_head=True,
+                       position_embedding_type="absolute", use_bias=True,
+                       glu_activation=None, use_rms_norm=False,
+                       tie_embed_logits=True)
+            pcfg = ParallelConfig(data_parallel_size=2,
+                                  num_microbatches=1,
+                                  use_distributed_optimizer=True,
+                                  overlap_grad_reduce=True)
+            initialize_parallel(dp=2, pp=1, tp=1)
+            try:
+                make_train_step(BertModel(cfg), TrainConfig(lr=1e-3),
+                                pcfg)
+            finally:
+                destroy_parallel()
+
+
+class TestOverlapGauges:
+    def test_step0_gauges(self):
+        """Step-0 facts for a scheduled run: per-bucket wire bytes (the
+        bucket-sizing tuning surface), the overlap marker, and — under
+        the log_memory opt-in — the measured async-pair gauge (0 on
+        this backend, by measurement)."""
+        _, _, _, _, _, _, gauges = _run(2, overlap=True, gather=True,
+                                        steps=1, log_memory=True)
+        assert gauges.get("zero1-overlap") == "grads+gather"
+        bb = gauges.get("grad-rs-bucket-bytes")
+        assert isinstance(bb, list) and len(bb) >= 2 and all(
+            b > 0 for b in bb)
+        assert gauges.get("grad-rs-buckets") == len(bb)
+        assert gauges.get("grad-comm-overlap-pairs") == 0  # CPU backend
+
+
+class TestAsyncPipelineDispatch:
+    def test_pp2_loss_and_grads_bitwise(self):
+        """--async_pipeline_dispatch vs the lockstep schedule: same
+        loss, same grads, on a deterministic pp2 run — the
+        double-buffered carry only delays each boundary hop, it never
+        changes per-microbatch math."""
+        from jax.sharding import NamedSharding
+
+        from megatron_llm_tpu.parallel.pipeline import (
+            make_pipelined_loss_fn,
+            pipeline_param_specs,
+        )
+
+        cfg = _cfg(num_layers=4)
+
+        def run(async_dispatch):
+            pcfg = ParallelConfig(pipeline_parallel_size=2,
+                                  num_microbatches=4,
+                                  async_pipeline_dispatch=async_dispatch)
+            ctx = initialize_parallel(dp=1, pp=2, tp=1)
+            try:
+                model = LlamaModel(cfg)
+                tmpl = jax.eval_shape(model.init, jax.random.key(0))
+                specs = pipeline_param_specs(cfg, tmpl)
+                sh = jax.tree.map(
+                    lambda s: NamedSharding(ctx.mesh, s), specs,
+                    is_leaf=lambda x: isinstance(x, P))
+                params = jax.jit(model.init, out_shardings=sh)(
+                    jax.random.key(0))
+                loss_fn = make_pipelined_loss_fn(model, pcfg, ctx)
+                rs = np.random.RandomState(0)
+                batch = {
+                    "tokens": jnp.asarray(
+                        rs.randint(0, VOCAB, (4, 2, SEQ)), jnp.int32),
+                    "labels": jnp.asarray(
+                        rs.randint(0, VOCAB, (4, 2, SEQ)), jnp.int32),
+                }
+                loss, grads = jax.jit(jax.value_and_grad(loss_fn))(
+                    params, batch)
+                return float(loss), jax.tree.map(np.asarray, grads)
+            finally:
+                destroy_parallel()
+
+        l_lock, g_lock = run(False)
+        l_async, g_async = run(True)
+        assert l_lock == l_async, (l_lock, l_async)
+        assert _trees_equal(g_lock, g_async)
