@@ -19,9 +19,12 @@ a production shape exists.
 
 Entry points audited (the registry's lowerable surface):
 - the five engine builders, through `DecodeEngine.audit_entry_points()`
-  against the engine's REAL pools (mesh tag "single") — TWICE: once on
-  an fp engine and once on an int8-KV + weight-only-int8 engine
-  (ISSUE 9), so the quantized step programs meet the same contract;
+  against the engine's REAL pools (mesh tag "single") — THRICE: an fp
+  engine, an int8-KV + weight-only-int8 engine (ISSUE 9), and a
+  telemetry-on engine (ISSUE 13: live span tracer + flight recorder
+  around the mint; _check_telemetry_parity pins its artifacts
+  identical to the fp engine's — inventory equality, zero host
+  callbacks — so telemetry can never leak into jitted code);
 - `ops.weight_quant`, the one-shot fp->int8 decode-weight quantizer;
 - `train.step` on tp2 AND dp2x2 meshes — the two forecast mesh shapes
   whose collective inventories ROADMAP items 1/2/4 will be verified
@@ -230,6 +233,8 @@ def _audit_engine() -> List[TargetResult]:
     time, so the audit and the runtime cannot drift; kv_dtype is an
     engine-level choice and must never mint extra variants (the two
     engines are two owners with identical bucket budgets)."""
+    import tempfile
+
     from megatron_llm_tpu.inference.engine import (
         DecodeEngine,
         horizon_buckets,
@@ -246,6 +251,17 @@ def _audit_engine() -> List[TargetResult]:
         model, params, slots=2, page_size=16, max_context=64,
         step_horizon=8, prefill_chunk_tokens=16, spec_decode_k=2,
         kv_dtype="int8", quantize_weights=True, vocab_size=256)
+    # telemetry-on engine (ISSUE 13): live span tracer + flight
+    # recorder while the entry points mint and lower — the contract is
+    # that the compiled artifacts are IDENTICAL to the telemetry-off
+    # engine's (collective inventory, zero host callbacks), checked by
+    # _check_telemetry_parity below. Emission is host-side by design;
+    # this row exists so a future change that threads telemetry INTO a
+    # jitted step fails the audit, not a production trace.
+    eng_t = DecodeEngine(
+        model, params, slots=2, page_size=16, max_context=64,
+        step_horizon=8, prefill_chunk_tokens=16, spec_decode_k=2,
+        vocab_size=256, trace_dir=tempfile.mkdtemp(prefix="graft_audit_"))
 
     results = []
     for name, fn, args in eng.audit_entry_points():
@@ -253,6 +269,12 @@ def _audit_engine() -> List[TargetResult]:
     for name, fn, args in eng_q.audit_entry_points():
         res = audit_lowered(name, "single", fn, args)
         res.facts["quantized"] = True  # int8 KV + int8 decode weights
+        results.append(res)
+    for name, fn, args in eng_t.audit_entry_points():
+        with eng_t.tracer.span("audit_lower", contract=name):
+            res = audit_lowered(name, "single", fn, args)
+        eng_t.recorder.record("audit_lower", contract=name)
+        res.facts["telemetry"] = True
         results.append(res)
     # the one-shot weight quantizer itself (fp decode tree -> weight-
     # only int8): a registered jitted entry point like any other
@@ -334,6 +356,12 @@ def _audit_train_step(mesh_tag: str) -> TargetResult:
     zero1 = "+zero1" in mesh_tag
     quant = "-quant" in mesh_tag
     overlap = "+overlap" in mesh_tag
+    # "+telemetry" (ISSUE 13): the SAME build as the base tag, but the
+    # specialization mints and lowers with a live span tracer + flight
+    # recorder around it — exactly the trainer's instrumentation. The
+    # artifact must be identical to the base row's
+    # (_check_telemetry_parity); telemetry is host-side by contract.
+    telemetry = "+telemetry" in mesh_tag
     cfg = _audit_train_config(num_layers=4 if overlap else 2)
     model = LlamaModel(cfg)
     ctx = initialize_parallel(dp=dp, pp=1, tp=tp)
@@ -395,10 +423,22 @@ def _audit_train_step(mesh_tag: str) -> TargetResult:
         # trainer.py "ONE trace either way"), so the audited HLO must
         # contain the found_inf machinery traffic actually runs. rng
         # stays None — the no-dropout config's own specialization.
-        return audit_lowered(
-            "train.step", mesh_tag, step,
-            (params, opt_state, batch, jnp.float32(1e-4),
-             jnp.float32(0.0), None, jnp.float32(np.inf)))
+        lower_args = (params, opt_state, batch, jnp.float32(1e-4),
+                      jnp.float32(0.0), None, jnp.float32(np.inf))
+        if not telemetry:
+            return audit_lowered("train.step", mesh_tag, step,
+                                 lower_args)
+        from megatron_llm_tpu.telemetry import FlightRecorder, SpanTracer
+
+        tracer = SpanTracer(enabled=True)
+        recorder = FlightRecorder(64)
+        tracer.set_context(step=0)
+        with tracer.span("train-step"):
+            res = audit_lowered("train.step", mesh_tag, step, lower_args)
+        recorder.record("step", step=0, loss=0.0)
+        res.facts["telemetry"] = True
+        res.facts["telemetry_events"] = len(tracer.events())
+        return res
     finally:
         destroy_parallel()
 
@@ -662,6 +702,52 @@ def _check_overlap_schedule(results: List[TargetResult]) -> None:
                 f"issue points collapsed into a post-backward clump")
 
 
+def _check_telemetry_parity(results: List[TargetResult]) -> None:
+    """ISSUE 13 acceptance: specializations lowered with telemetry live
+    (span tracer + flight recorder recording around the mint) must be
+    the SAME compiled program family as telemetry-off — identical
+    collective inventory, zero host callbacks, same fp64 verdict. All
+    telemetry emission is host bookkeeping outside jit by design; this
+    pin turns that design rule into a gate, so threading a span or an
+    event into a jitted step (the classic io_callback 'just log it from
+    the device' shortcut) fails the audit instead of a production run."""
+    # engine rows: telemetry-on vs the plain fp engine, per contract
+    base: Dict[str, TargetResult] = {}
+    for r in results:
+        if (r.contract.startswith("engine.")
+                and "telemetry" not in r.facts
+                and "quantized" not in r.facts):
+            base.setdefault(r.contract, r)
+    pairs = [(r, base.get(r.contract)) for r in results
+             if r.contract.startswith("engine.")
+             and r.facts.get("telemetry")]
+    # train.step: the +telemetry tag vs its base tag
+    by_tag = {r.mesh_tag: r for r in results
+              if r.contract == "train.step"}
+    for tag, r in by_tag.items():
+        if tag.endswith("+telemetry"):
+            pairs.append((r, by_tag.get(tag[:-len("+telemetry")])))
+    for r, b in pairs:
+        if b is None:
+            r.fail("no telemetry-off twin row to compare against — "
+                   "the parity pin needs both specializations lowered")
+            continue
+        if r.facts.get("collectives") != b.facts.get("collectives"):
+            r.fail(
+                f"telemetry-on collective inventory "
+                f"{r.facts.get('collectives')} != telemetry-off "
+                f"{b.facts.get('collectives')} ({b.mesh_tag}): telemetry "
+                f"leaked into the jitted program — emission must stay "
+                f"host-side (telemetry/ module contract)")
+        if r.facts.get("host_callbacks"):
+            r.fail(
+                f"telemetry-on specialization lowered host callbacks "
+                f"{r.facts['host_callbacks']}: a span/event emitter is "
+                f"being called FROM traced code")
+        if r.facts.get("f64") != b.facts.get("f64"):
+            r.fail("telemetry-on fp64 verdict differs from telemetry-off")
+
+
 def audit_repo(root: str) -> dict:
     """Run the full audit: lower every reference target, check marker
     consistency, and return a JSON-able report. Requires >= 4 devices
@@ -676,7 +762,8 @@ def audit_repo(root: str) -> dict:
     # decomposition's collective inventory (reduce-scatter on the
     # pure-dp mesh; the quantized variant's all-to-all) and the
     # dp-sharded optimizer-state args bytes below.
-    for tag in ("tp2", "dp2", "dp2+zero1", "dp2+zero1-quant",
+    for tag in ("tp2", "dp2", "dp2+telemetry", "dp2+zero1",
+                "dp2+zero1-quant",
                 "dp2+zero1+overlap", "dp2+zero1-quant+overlap",
                 "dp2tp2", "dp2tp2+zero1"):
         dp, tp = _mesh_shape_for_tag(tag)
@@ -689,6 +776,7 @@ def audit_repo(root: str) -> dict:
         results.append(_audit_train_step(tag))
     _check_zero1_state_bytes(results)
     _check_overlap_schedule(results)
+    _check_telemetry_parity(results)
     results.append(_audit_generate_tokens())
     results.append(_audit_chunk_topk())
     results.append(_audit_flash_attention())

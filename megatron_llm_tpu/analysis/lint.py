@@ -83,6 +83,7 @@ RULES: Dict[str, str] = {
 HOT_PATHS: Dict[str, Set[str]] = {
     "megatron_llm_tpu/inference/engine.py": {
         "DecodeEngine.step",
+        "DecodeEngine._step_inner",
         "DecodeEngine._decode_round",
         "DecodeEngine._mixed_round",
         "DecodeEngine._spec_round",
@@ -92,6 +93,28 @@ HOT_PATHS: Dict[str, Set[str]] = {
     "megatron_llm_tpu/training/trainer.py": {
         "Trainer.train_step",
         "Trainer.train",
+    },
+    # telemetry emit sites (ISSUE 13): called once or more per engine
+    # round / train step — per-round span/event/histogram bookkeeping
+    # must stay pure host arithmetic, never a device sync. The fixtures
+    # gr006_span_{good,bad}.py pin the pattern.
+    "megatron_llm_tpu/telemetry/trace.py": {
+        "SpanTracer.span",
+        "SpanTracer.instant",
+        "SpanTracer.complete",
+        "SpanTracer.set_context",
+        "SpanTracer._push",
+        "SpanTracer._ts",
+        "SpanTracer._tid",
+        "_Span.__enter__",
+        "_Span.__exit__",
+    },
+    "megatron_llm_tpu/telemetry/recorder.py": {
+        "FlightRecorder.record",
+        "FlightRecorder.note_counters",
+    },
+    "megatron_llm_tpu/telemetry/prometheus.py": {
+        "Histogram.observe",
     },
 }
 

@@ -456,6 +456,25 @@ def build_base_parser() -> argparse.ArgumentParser:
     g.add_argument("--profile_step_start", type=int, default=10)
     g.add_argument("--profile_step_end", type=int, default=12)
     g.add_argument("--profile_dir", type=str, default=None)
+    # flight-recorder telemetry (ISSUE 13, megatron_llm_tpu/telemetry/;
+    # docs/GUIDE.md "Observability")
+    g.add_argument("--profile_step_range", nargs=2, type=int, default=None,
+                   metavar=("START", "END"),
+                   help="shorthand for --profile --profile_step_start "
+                        "START --profile_step_end END: capture a "
+                        "jax.profiler device trace over [START, END)")
+    g.add_argument("--trace_dir", type=str, default=None,
+                   help="enable the host span tracer; the Chrome "
+                        "trace-event JSON (Perfetto-loadable) exports "
+                        "here at the end of training")
+    g.add_argument("--flight_record_dir", type=str, default=None,
+                   help="where flight-recorder crash artifacts are "
+                        "dumped (watchdog rollback, SIGTERM emergency "
+                        "save); default: the --save dir")
+    g.add_argument("--flight_recorder_size", type=int, default=4096,
+                   help="bounded ring of recent structured events the "
+                        "flight recorder keeps (per-step/lifecycle; "
+                        "the crash artifact's history depth)")
 
     # reference flags whose behavior is unconditionally provided (accepted,
     # recorded) or descoped (rejected in args_to_configs with the reason).
@@ -490,6 +509,13 @@ def args_to_configs(args, padded_vocab_size: int):
     if args.recompute_activations and args.recompute_granularity is None:
         # ref shorthand (arguments.py:649-652)
         args.recompute_granularity = "selective"
+
+    if args.profile_step_range is not None:
+        start, end = args.profile_step_range
+        if start < 0 or end <= start:
+            raise SystemExit(
+                f"--profile_step_range {start} {end}: requires "
+                f"0 <= START < END (the capture window is [START, END))")
 
     if args.data_path and (args.train_data_path or args.valid_data_path
                            or args.test_data_path):
@@ -678,10 +704,17 @@ def args_to_configs(args, padded_vocab_size: int):
         wandb_api_key=args.wandb_api_key,
         log_params_norm=args.log_params_norm,
         log_num_zeros_in_grad=args.log_num_zeros_in_grad,
-        profile=args.profile,
-        profile_step_start=args.profile_step_start,
-        profile_step_end=args.profile_step_end,
+        profile=args.profile or args.profile_step_range is not None,
+        profile_step_start=(args.profile_step_range[0]
+                            if args.profile_step_range is not None
+                            else args.profile_step_start),
+        profile_step_end=(args.profile_step_range[1]
+                          if args.profile_step_range is not None
+                          else args.profile_step_end),
         profile_dir=args.profile_dir,
+        trace_dir=args.trace_dir,
+        flight_record_dir=args.flight_record_dir,
+        flight_recorder_size=args.flight_recorder_size,
         seed=args.seed,
     )
 

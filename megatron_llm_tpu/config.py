@@ -558,6 +558,16 @@ class TrainConfig:
     profile_step_start: int = 10
     profile_step_end: int = 12
     profile_dir: Optional[str] = None
+    # flight-recorder telemetry (ISSUE 13, megatron_llm_tpu/telemetry/):
+    # trace_dir enables the host span tracer (Chrome trace-event JSON,
+    # exported at the end of train()); the flight recorder is ALWAYS on
+    # (bounded event ring, auto-dumped on watchdog rollback + SIGTERM
+    # emergency save), dumping into flight_record_dir (default: the
+    # --save dir). Telemetry never touches jitted code — telemetry-on
+    # steps are bitwise telemetry-off (tests/test_telemetry.py).
+    trace_dir: Optional[str] = None
+    flight_record_dir: Optional[str] = None
+    flight_recorder_size: int = 4096
 
     seed: int = 1234
 

@@ -118,6 +118,13 @@ from megatron_llm_tpu.inference.sampling import (
     NEG_INF,
     modify_logits_for_top_p,
 )
+from megatron_llm_tpu.telemetry import (
+    NULL_TRACER,
+    FlightRecorder,
+    Histogram,
+    SpanTracer,
+    render_prometheus,
+)
 
 _logger = logging.getLogger(__name__)
 
@@ -718,6 +725,13 @@ class DecodeEngine:
       the decode GEMV weights (per-output-channel scales,
       prepare_decode_params(quantize_int8=True)); decode matvecs read
       half the weight bytes. Decode-only — the fp tree is untouched.
+    - `trace_dir` (ISSUE 13): enable the host span tracer; the Chrome
+      trace-event JSON exports here at stop(). `record_dir`: where the
+      flight recorder dumps its crash artifact (defaults to trace_dir;
+      None = in-memory + log-summary only). `flight_recorder_size`:
+      the event ring bound. Telemetry never touches jitted code —
+      telemetry-on steps are bitwise telemetry-off
+      (docs/GUIDE.md "Observability").
 
     Pages are reserved UP FRONT at admission for the request's whole
     prompt + tokens_to_generate reach, so a running request can never
@@ -736,7 +750,10 @@ class DecodeEngine:
                  kv_dtype: str = "bf16",
                  quantize_weights: bool = False,
                  termination_id: Optional[int] = None,
-                 vocab_size: Optional[int] = None, timers=None):
+                 vocab_size: Optional[int] = None, timers=None,
+                 trace_dir: Optional[str] = None,
+                 record_dir: Optional[str] = None,
+                 flight_recorder_size: int = 4096):
         assert max_context % page_size == 0, \
             "max_context must be a multiple of page_size"
         if kv_dtype not in ("bf16", "int8"):
@@ -878,6 +895,44 @@ class DecodeEngine:
         self._round_log: collections.deque = collections.deque(
             maxlen=4096)
 
+        # -- telemetry (ISSUE 13) -----------------------------------------
+        # Span tracer: enabled only with a trace_dir (the off path is
+        # one attribute check per emit site); exported as Chrome trace
+        # JSON at stop(). Flight recorder: ALWAYS on — a bounded ring
+        # of per-round/lifecycle events auto-dumped on serve-loop
+        # poison (record_dir; falls back to trace_dir) and served on
+        # demand at GET /flight_record. Histograms: the distributional
+        # SLO metrics behind the Prometheus text exposition on
+        # GET /metrics. NONE of this touches jitted code: telemetry-on
+        # steps are bitwise telemetry-off (tests/test_telemetry.py +
+        # the graft-check audit pin it).
+        self.trace_dir = trace_dir
+        self.record_dir = record_dir if record_dir is not None else trace_dir
+        self.tracer: SpanTracer = (SpanTracer(enabled=True)
+                                   if trace_dir else NULL_TRACER)
+        self.recorder = FlightRecorder(flight_recorder_size)
+        self._hists = {
+            "serve_ttft_ms": Histogram(
+                "serve_ttft_ms", help_text="submit -> first generated "
+                "token, per request"),
+            "serve_decode_round_ms": Histogram(
+                "serve_decode_round_ms", help_text="wall ms per decode-"
+                "token advance per round (mixed rounds included: the "
+                "chunked-prefill interference distribution)"),
+            "serve_queue_wait_ms": Histogram(
+                "serve_queue_wait_ms", help_text="submit -> slot "
+                "admission, per request"),
+        }
+        self._rounds = 0  # did-work scheduler rounds (telemetry clock)
+        # jax.profiler capture hook (POST /profile): armed request ->
+        # started before the next round, stopped after N did-work
+        # rounds; start/stop failures are LOGGED no-ops (capture is a
+        # diagnostic, never a crash source)
+        self._profile_pending: Optional[tuple] = None
+        self._profile_active = False
+        self._profile_left = 0
+        self._profile_dir: Optional[str] = None
+
     # -- admission ---------------------------------------------------------
 
     def submit(self, prompt: List[int], tokens_to_generate: int, *,
@@ -948,6 +1003,12 @@ class DecodeEngine:
             self._next_rid += 1
             self._queue.append(req)
             self._work.notify()
+        # per-request ID assigned above is THE correlation key: it rides
+        # SSE `id:` fields, server error logs, trace spans and these
+        # flight-recorder events (ISSUE 13)
+        self.recorder.record(
+            "submit", rid=req.rid, prompt_tokens=len(req.prompt),
+            tokens_to_generate=tokens_to_generate, stream=stream)
         return req
 
     @staticmethod
@@ -1050,8 +1111,14 @@ class DecodeEngine:
                         and self._prefix is not None:
                     # reclaim unreferenced cached prefixes (LRU) before
                     # blocking the FIFO head on pages
-                    self._free_pages.extend(self._prefix.evict(
-                        need_new - len(self._free_pages)))
+                    evicted = self._prefix.evict(
+                        need_new - len(self._free_pages))
+                    if evicted:
+                        self.tracer.instant("prefix_evict", rid=req.rid,
+                                            pages=len(evicted))
+                        self.recorder.record("prefix_evict", rid=req.rid,
+                                             pages=len(evicted))
+                    self._free_pages.extend(evicted)
                 if len(self._free_pages) < need_new:
                     if match is not None:
                         self._prefix.unacquire(match)
@@ -1088,13 +1155,17 @@ class DecodeEngine:
                         # prefill resumes at the divergence offset
                         # inside it, so the shared page never sees this
                         # request's writes
-                        (self._pools_k, self._pools_v, self._pools_ks,
-                         self._pools_vs) = self._copy_fn(
-                            self._pools_k, self._pools_v,
-                            self._pools_ks, self._pools_vs,
-                            jnp.asarray(match.cow_src, jnp.int32),
-                            jnp.asarray(pages[match.full_pages],
-                                        jnp.int32))
+                        with self.tracer.span(
+                                "cow_copy", rid=req.rid,
+                                src=match.cow_src,
+                                dst=pages[match.full_pages]):
+                            (self._pools_k, self._pools_v, self._pools_ks,
+                             self._pools_vs) = self._copy_fn(
+                                self._pools_k, self._pools_v,
+                                self._pools_ks, self._pools_vs,
+                                jnp.asarray(match.cow_src, jnp.int32),
+                                jnp.asarray(pages[match.full_pages],
+                                            jnp.int32))
                         self._prefix.release_page(match.cow_src)
                         self._prefix.cow_copies += 1
                 if self._prefix is not None:
@@ -1104,15 +1175,17 @@ class DecodeEngine:
                 self._lengths[si] = matched
             else:
                 plen = bucket_prefill_len(len(req.prompt))
-                (self._pools_k, self._pools_v, self._pools_ks,
-                 self._pools_vs, row_logits, plp) = \
-                    self._prefill_fn(plen)(
-                        self._dec_params, self._pools_k, self._pools_v,
-                        self._pools_ks, self._pools_vs,
-                        jnp.asarray(np.asarray(req.prompt[:plen],
-                                               np.int32)[None]),
-                        jnp.asarray(self._pt[si]),
-                    )
+                with self.tracer.span("prefill_bucket", rid=req.rid,
+                                      slot=si, tokens=plen):
+                    (self._pools_k, self._pools_v, self._pools_ks,
+                     self._pools_vs, row_logits, plp) = \
+                        self._prefill_fn(plen)(
+                            self._dec_params, self._pools_k, self._pools_v,
+                            self._pools_ks, self._pools_vs,
+                            jnp.asarray(np.asarray(req.prompt[:plen],
+                                                   np.int32)[None]),
+                            jnp.asarray(self._pt[si]),
+                        )
                 self._last_logits = \
                     self._last_logits.at[si].set(row_logits)
                 self._lengths[si] = plen
@@ -1123,6 +1196,17 @@ class DecodeEngine:
                 if req.return_log_probs:
                     req.log_probs = [float(x) for x in np.asarray(plp)]
             req.t_admit = time.perf_counter()
+            # queue-wait telemetry: a retroactive span from the
+            # request's own stamps (submit -> admission), plus the
+            # histogram behind the Prometheus exposition
+            wait_ms = (req.t_admit - req.t_submit) * 1e3
+            self.tracer.complete("queue_wait", req.t_submit, req.t_admit,
+                                 rid=req.rid, slot=si)
+            self._hists["serve_queue_wait_ms"].observe(wait_ms)
+            self.recorder.record(
+                "admit", rid=req.rid, slot=si,
+                queue_wait_ms=round(wait_ms, 3),
+                prefill_start=slot.prefill_pos, pages=need)
             self._admitted += 1
         return prefilled
 
@@ -1146,6 +1230,11 @@ class DecodeEngine:
         slot.req = None
         req.t_done = time.perf_counter()
         self._retired += 1
+        self.tracer.instant("retire", rid=req.rid, slot=si,
+                            generated=slot.generated,
+                            error=req.error is not None)
+        self.recorder.record("retire", rid=req.rid, slot=si,
+                             generated=slot.generated, error=req.error)
         self._finish(req)
 
     # -- the decode loop ---------------------------------------------------
@@ -1196,8 +1285,12 @@ class DecodeEngine:
         self._tokens_out += 1
         if s.generated == 1:
             r.t_first = now if now is not None else time.perf_counter()
+            ttft = (r.t_first - r.t_submit) * 1e3
             with self._lock:  # counters() sorts this window concurrently
-                self._ttft_ms.append((r.t_first - r.t_submit) * 1e3)
+                self._ttft_ms.append(ttft)
+            self._hists["serve_ttft_ms"].observe(ttft)
+            self.tracer.instant("first_token", rid=r.rid,
+                                ttft_ms=round(ttft, 3))
         hit_eod = (r.use_eod_for_early_termination
                    and self.termination_id is not None
                    and tok == self.termination_id)
@@ -1227,6 +1320,8 @@ class DecodeEngine:
                        f"{r.deadline_s} while queued")
             r.timed_out = True
             self._timed_out += 1
+            self.recorder.record("timeout_queued", rid=r.rid,
+                                 deadline_s=r.deadline_s)
             self._finish(r)
         for i, s in enumerate(self._slots):
             r = s.req
@@ -1255,6 +1350,93 @@ class DecodeEngine:
                 self._retire(i)
 
     def step(self) -> bool:
+        """One scheduler iteration (see _step_inner for the scheduling
+        contract). This wrapper owns the telemetry clock (ISSUE 13):
+        the jax.profiler capture hook (POST /profile) starts before /
+        stops after the requested number of did-work rounds, the
+        did-work round counter feeds span correlation, and every 256
+        rounds the flight recorder takes a counters() snapshot. All of
+        it is host bookkeeping — the jitted dispatches inside are
+        telemetry-blind."""
+        if self._profile_pending is not None:
+            self._start_profile()
+        did = self._step_inner()
+        if did:
+            self._rounds += 1
+            if self._rounds % 256 == 0:
+                self.recorder.note_counters(self.counters())
+        if self._profile_active:
+            self._tick_profile(did)
+        return did
+
+    def request_profile(self, rounds: int,
+                        trace_dir: Optional[str] = None) -> dict:
+        """Arm a `jax.profiler` device capture of the next `rounds`
+        did-work engine rounds (the POST /profile hook). The capture
+        starts before the next round the serve loop runs and stops
+        once `rounds` have completed; start/stop failures (no profiler
+        on this runtime, a capture already running out-of-band) are
+        LOGGED no-ops recorded in the flight ring — a diagnostic hook
+        must never take the serve loop down. One capture at a time:
+        a second request while one is armed/active is refused."""
+        if rounds < 1:
+            raise ValueError(f"rounds must be >= 1, got {rounds}")
+        d = trace_dir or self.trace_dir or "./profile"
+        with self._lock:
+            if self._profile_active or self._profile_pending is not None:
+                return {"ok": False,
+                        "error": "a profiler capture is already in "
+                                 "progress"}
+            self._profile_pending = (int(rounds), d)
+            self._work.notify()
+        self.recorder.record("profile_armed", rounds=int(rounds), dir=d)
+        return {"ok": True, "rounds": int(rounds), "trace_dir": d}
+
+    def _start_profile(self) -> None:
+        with self._lock:
+            pending, self._profile_pending = self._profile_pending, None
+            if pending is not None:
+                # claim the one-capture slot BEFORE the unlocked
+                # start_trace below: a request_profile racing in here
+                # must see busy, not arm a second capture the profiler
+                # will refuse
+                rounds, d = pending
+                self._profile_active = True
+                self._profile_left = rounds
+                self._profile_dir = d
+        if pending is None:
+            return
+        try:
+            jax.profiler.start_trace(d)
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            with self._lock:
+                self._profile_active = False
+            _logger.warning(
+                "jax.profiler capture unavailable (%r): the /profile "
+                "request is a no-op on this runtime", e)
+            self.recorder.record("profile_unsupported", error=repr(e))
+            return
+        self.recorder.record("profile_start", rounds=rounds, dir=d)
+
+    def _tick_profile(self, did: bool) -> None:
+        if did:
+            self._profile_left -= 1
+        if self._profile_left <= 0:
+            self._stop_profile()
+
+    def _stop_profile(self) -> None:
+        if not self._profile_active:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            _logger.warning("jax.profiler stop_trace failed: %r", e)
+        with self._lock:
+            self._profile_active = False
+        self.recorder.record("profile_done", dir=self._profile_dir)
+        _logger.info("profiler capture complete: %s", self._profile_dir)
+
+    def _step_inner(self) -> bool:
         """One scheduler iteration. Chunked admission (the default):
         while any slot is mid-prefill, run one MIXED round — a budget-
         bounded ragged chunk of the oldest admitting prompt plus one
@@ -1266,17 +1448,37 @@ class DecodeEngine:
         was nothing to do (idle)."""
         t0 = time.perf_counter()
         self._expire_deadlines()
+        admitted_before = self._admitted
+        t_adm = time.perf_counter()
         admit_prefilled = self._admit()
+        if self._admitted != admitted_before:
+            self.tracer.complete(
+                "admit", t_adm, time.perf_counter(),
+                admitted=self._admitted - admitted_before,
+                prefilled_tokens=admit_prefilled)
         if self.prefill_chunk_tokens and any(
                 s.prefilling for s in self._slots):
-            dec_steps, pf_tokens = self._mixed_round()
-            dt_ms = (time.perf_counter() - t0) * 1e3
+            dec_steps, pf_tokens, chunk_rid = self._mixed_round()
+            t1 = time.perf_counter()
+            dt_ms = (t1 - t0) * 1e3
             with self._lock:  # counters() reads these windows concurrently
                 self._round_log.append({
                     "prefill_tokens": pf_tokens, "decode_steps": 1,
                     "decode_slots": dec_steps, "ms": dt_ms})
                 if dec_steps:
                     self._decode_ms.append(dt_ms)
+            if dec_steps:
+                self._hists["serve_decode_round_ms"].observe(dt_ms)
+            # chunk-prefill span: rid-correlated — a streaming client's
+            # stalled `id:` greps straight to these rounds
+            self.tracer.complete(
+                "round.mixed", t0, t1, round=self._rounds,
+                rid=chunk_rid, prefill_tokens=pf_tokens,
+                decode_slots=dec_steps)
+            self.recorder.record(
+                "round.mixed", round=self._rounds, rid=chunk_rid,
+                prefill_tokens=pf_tokens, decode_slots=dec_steps,
+                ms=round(dt_ms, 3))
             return True
         if self.spec_decode_k:
             drafts = self._collect_drafts()
@@ -1367,7 +1569,8 @@ class DecodeEngine:
                     s.forced.popleft()  # prompt token, already in tokens
                     continue
                 self._book_token(i, int(chosen[i, t]), now)
-        dt_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        dt_ms = (t1 - t0) * 1e3
         with self._lock:  # counters() reads these windows concurrently
             self._round_log.append({
                 "prefill_tokens": prefill_tokens, "decode_steps": hor,
@@ -1376,6 +1579,15 @@ class DecodeEngine:
             # steps (the whole-prompt admission stall, when any, rides
             # this round's wall time — that IS the interference)
             self._decode_ms.append(dt_ms / hor)
+        self._hists["serve_decode_round_ms"].observe(dt_ms / hor)
+        self.tracer.complete("round.decode_scan", t0, t1,
+                             round=self._rounds, horizon=hor,
+                             decode_slots=len(live),
+                             prefill_tokens=prefill_tokens)
+        self.recorder.record("round.decode_scan", round=self._rounds,
+                             horizon=hor, decode_slots=len(live),
+                             prefill_tokens=prefill_tokens,
+                             ms=round(dt_ms, 3))
         return True
 
     def _mixed_round(self):
@@ -1385,7 +1597,9 @@ class DecodeEngine:
         span resumed at its saved offset; every fully-prefilled live
         slot contributes one decode token; other admitting slots sit
         idle (chunk_lens 0). One jitted dispatch serves all of it.
-        Returns (decode slots advanced, prefill tokens consumed)."""
+        Returns (decode slots advanced, prefill tokens consumed, the
+        chunk request's rid — the round's trace-span correlation
+        key)."""
         n = self.slots
         pref = [i for i, s in enumerate(self._slots) if s.prefilling]
         ci = min(pref, key=lambda i: self._slots[i].req.rid)
@@ -1473,7 +1687,7 @@ class DecodeEngine:
             if r.return_log_probs:
                 r.log_probs.append(float(first_lp[i]))
             self._book_token(i, int(first[i]), now)
-        return len(dec), ln
+        return len(dec), ln, s_c.req.rid
 
     # -- prefix sharing ----------------------------------------------------
 
@@ -1673,7 +1887,8 @@ class DecodeEngine:
             # that gauge to decide whether spec decode pays for itself
             self._spec_accepted += booked - 1
 
-        dt_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        dt_ms = (t1 - t0) * 1e3
         per_advance = dt_ms * len(live) / max(emitted_total, 1)
         with self._lock:  # counters() reads these windows concurrently
             # prefill_tokens: whole-prompt-mode _admit() ran its device
@@ -1686,6 +1901,15 @@ class DecodeEngine:
             # per decode-token advance: one spec round advances
             # emitted/live tokens per slot
             self._decode_ms.append(per_advance)
+        self._hists["serve_decode_round_ms"].observe(per_advance)
+        self.tracer.complete("round.spec_verify", t0, t1,
+                             round=self._rounds, decode_slots=len(live),
+                             emitted=emitted_total,
+                             drafted=len(drafts))
+        self.recorder.record("round.spec_verify", round=self._rounds,
+                             decode_slots=len(live),
+                             emitted=emitted_total, drafted=len(drafts),
+                             ms=round(dt_ms, 3))
 
     def drain(self):
         """Run until the queue and every slot are empty."""
@@ -1886,6 +2110,18 @@ class DecodeEngine:
                     self._broken = f"engine step failed: {e!r}"
                     _logger.exception("serve loop died; failing all "
                                       "in-flight requests")
+                    # flight-recorder postmortem (ISSUE 13): the last-
+                    # N-rounds record + live counters, BEFORE _fail_all
+                    # clears the slots — the artifact must show what
+                    # the engine was doing when it died, keyed by rid
+                    self.recorder.record(
+                        "poison", error=repr(e), round=self._rounds,
+                        queue_depth=len(self._queue),
+                        live_rids=[s.req.rid for s in self._slots
+                                   if s.req is not None])
+                    self.recorder.note_counters(self.counters())
+                    self.recorder.dump(self.record_dir, "engine-poison")
+                    self._stop_profile()
                     self._fail_all(self._broken)
                     self._running = False
                     return
@@ -1916,6 +2152,15 @@ class DecodeEngine:
             self._work.notify_all()
         self._thread.join()
         self._thread = None
+        self._stop_profile()  # an interrupted capture still flushes
+        if self.trace_dir:
+            import os as _os
+
+            path = self.tracer.export(_os.path.join(
+                self.trace_dir, f"trace_engine_{_os.getpid()}.json"))
+            if path:
+                _logger.info("engine span trace exported to %s "
+                             "(Perfetto / chrome://tracing)", path)
         if not drain:
             self._fail_all("engine stopped")
 
@@ -2031,3 +2276,24 @@ class DecodeEngine:
             return
         for name, value in self.counters().items():
             timers.gauge(name, value)
+
+    def histograms(self):
+        """The engine's latency histograms (telemetry/prometheus.py):
+        TTFT, per-decode-token-advance round ms, queue wait — the
+        distributional SLO metrics the point-percentile gauges in
+        counters() cannot express."""
+        return list(self._hists.values())
+
+    def prometheus_metrics(self) -> str:
+        """The Prometheus text exposition GET /metrics serves under
+        content negotiation: every numeric counter as a gauge, string
+        facts as one info metric, plus the real histograms. The JSON
+        path (counters()) stays byte-compatible and untouched."""
+        return render_prometheus(self.counters(), self.histograms())
+
+    def flight_record(self) -> dict:
+        """On-demand flight-recorder snapshot (GET /flight_record):
+        the same artifact a dying engine dumps, with live counters
+        attached."""
+        self.recorder.note_counters(self.counters())
+        return self.recorder.snapshot(reason="on-demand")

@@ -22,7 +22,23 @@ on the same device; stacking them hid the overload from the client).
 GET /metrics (engine-attached servers) returns the live
 `DecodeEngine.counters()` dict — slot occupancy, queue depth, page
 accounting, tok/s, and the ISSUE-4 latency gauges (serve_ttft_p50_ms /
-serve_ttft_p95_ms / serve_decode_p95_ms) — as JSON.
+serve_ttft_p95_ms / serve_decode_p95_ms) — as JSON. Under content
+negotiation (ISSUE 13: `Accept: text/plain` / `application/
+openmetrics-text`, or `?format=prometheus`) the same endpoint serves
+the Prometheus text exposition instead — every numeric counter as a
+gauge plus REAL histograms (TTFT / decode-round ms / queue wait,
+telemetry/prometheus.py); the default JSON schema is byte-compatible
+with the pre-telemetry surface (tests/test_telemetry.py pins it).
+
+Observability surface (ISSUE 13, engine-attached servers only):
+- GET /flight_record — the engine's flight-recorder snapshot (last-N
+  structured rounds + counters), the same artifact a dying engine
+  auto-dumps;
+- POST /profile {"rounds": N, "trace_dir": ...} — arm a jax.profiler
+  device capture of the next N engine rounds (one at a time; 409 when
+  busy; an unsupported runtime records a loud no-op);
+- GET /memory — per-device allocator stats (jax memory_stats), the
+  device-memory snapshot endpoint.
 
 GET /health (ISSUE 5) is the load-balancer probe: 200 while the serving
 path can take traffic, 503 once the engine's serve loop died poisoned
@@ -54,6 +70,29 @@ from megatron_llm_tpu.inference.api import (
 )
 
 _logger = logging.getLogger(__name__)
+
+def _wants_prometheus(accept: str, query: str) -> bool:
+    """/metrics content negotiation (ISSUE 13): serve the Prometheus
+    text exposition only when the client PREFERS it — an explicit
+    `?format=prometheus`, or an Accept header whose first matching
+    media type (left-to-right, the client's preference order) is a
+    text/openmetrics type rather than JSON. A bare substring test
+    would flip clients that merely LIST text/plain as a fallback
+    (axios' default `application/json, text/plain, */*`) off the
+    byte-compatible legacy JSON they were built against. q-values are
+    ignored; list order carries the preference, which every real
+    scraper/client default satisfies."""
+    if "format=prometheus" in query:
+        return True
+    for part in accept.split(","):
+        mtype = part.split(";", 1)[0].strip().lower()
+        if mtype in ("application/json", "*/*", "application/*"):
+            return False
+        if mtype in ("text/plain", "application/openmetrics-text",
+                     "text/*"):
+            return True
+    return False
+
 
 GENERATE_NUM = 0
 BEAM_NUM = 1
@@ -333,7 +372,10 @@ class MegatronGenerate:
                     # per-request deadline expiry (engine deadline_s) is
                     # overload shed, not an engine fault: 504 +
                     # Retry-After so clients and monitoring can tell it
-                    # from a real 5xx crash
+                    # from a real 5xx crash. rid in the log: the
+                    # correlation key into trace spans + flight record
+                    _logger.warning("engine request rid=%d timed out "
+                                    "(deadline shed)", r.rid)
                     return {"message": repr(e)}, 504
                 rows.append(toks)
                 lps.append(lp)
@@ -351,6 +393,10 @@ class MegatronGenerate:
                              if logprobs else None),
             }, 200
         except Exception as e:  # same jsonified-error contract (:230)
+            # log the request IDs this PUT carried (ISSUE 13): a 500 in
+            # a client's logs greps to the exact engine rounds by rid
+            _logger.error("engine generate PUT failed (rids=%s): %r",
+                          [r.rid for r in reqs], e)
             return {"message": repr(e)}, 500
 
     def put_stream(self, raw: dict, start_response, write_event):
@@ -476,30 +522,38 @@ class MegatronGenerate:
                         win_emitted = tok.detokenize(pending)
                         while win_emitted.endswith("�"):
                             win_emitted = win_emitted[:-1]
-                write_event({"token": int(t), "text": delta})
+                write_event({"token": int(t), "text": delta},
+                            rid=req.rid)
         except _queue.Empty:
             # stalled engine: reclaim the slot and tell the client
             # before closing — an EOF with no done event looks like a
             # transport bug, not a server decision
+            _logger.error("stream rid=%d stalled waiting for the "
+                          "engine; cancelling", req.rid)
             self.engine.cancel(req)
             try:
-                write_event({"done": True,
+                write_event({"done": True, "rid": req.rid,
                              "error": "timed out waiting for the "
-                                      "engine; request cancelled"})
+                                      "engine; request cancelled"},
+                            rid=req.rid)
             except Exception:
                 pass
             return None
         except Exception:
             # the client went away mid-stream: reclaim the slot + pages
-            # NOW instead of decoding for a closed socket
+            # NOW instead of decoding for a closed socket. rid in the
+            # log line: the greppable key into the engine's trace spans
+            # and flight record (ISSUE 13)
+            _logger.info("stream rid=%d aborted mid-flight; cancelling",
+                         req.rid)
             self.engine.cancel(req)
             raise
-        final = {"done": True, "tokens": list(out_ids)}
+        final = {"done": True, "rid": req.rid, "tokens": list(out_ids)}
         if req.error is not None:
-            final = {"done": True, "error": req.error}
+            final = {"done": True, "rid": req.rid, "error": req.error}
         else:
             final["text"] = tok.detokenize(ids + out_ids)
-        write_event(final)
+        write_event(final, rid=req.rid)
         return None
 
 
@@ -536,7 +590,8 @@ class _Handler(BaseHTTPRequestHandler):
                 {"status": "ok" if healthy else "unhealthy", "engine": h},
                 200 if healthy else 503)
             return
-        if self.path.rstrip("/") == "/metrics":
+        path, _, query = self.path.partition("?")
+        if path.rstrip("/") == "/metrics":
             # live engine counters (DecodeEngine.counters — occupancy,
             # queue depth, pages, tok/s, the latency gauges
             # serve_ttft_p50/p95_ms + serve_decode_p95_ms, and the
@@ -545,12 +600,89 @@ class _Handler(BaseHTTPRequestHandler):
             # same dict the timers-gauge export carries, so dashboards
             # and curl read one schema. 404 when no engine is attached
             # (whole-batch-only server has no per-request gauges).
+            # ISSUE 13: a Prometheus scraper negotiates the text
+            # exposition (with real histograms) via Accept or
+            # ?format=prometheus; the JSON default stays byte-compatible.
             if self.generator.engine is None:
                 self.send_error(404)
                 return
+            if _wants_prometheus(self.headers.get("Accept", ""), query):
+                from megatron_llm_tpu.telemetry import (
+                    PROMETHEUS_CONTENT_TYPE,
+                )
+
+                data = self.generator.engine.prometheus_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             self._respond(self.generator.engine.counters(), 200)
             return
+        if path.rstrip("/") == "/flight_record":
+            # on-demand flight-recorder snapshot (ISSUE 13): the same
+            # last-N-rounds record + counters a dying engine dumps —
+            # the live postmortem surface
+            if self.generator.engine is None:
+                self.send_error(404)
+                return
+            self._respond(self.generator.engine.flight_record(), 200)
+            return
+        if path.rstrip("/") == "/memory":
+            # device-memory snapshot (ISSUE 13): per-device allocator
+            # stats; devices without memory_stats report {} rather than
+            # failing the probe
+            import jax
+
+            devs = []
+            for d in jax.local_devices():
+                try:
+                    stats = d.memory_stats() or {}
+                except Exception:  # noqa: BLE001 — stats are optional
+                    stats = {}
+                devs.append({"device": str(d),
+                             "platform": d.platform, **stats})
+            self._respond({"devices": devs}, 200)
+            return
         self.send_error(404)
+
+    def do_POST(self):
+        # POST /profile (ISSUE 13): arm a jax.profiler capture of the
+        # next N engine rounds. One capture at a time (409 on overlap);
+        # unsupported runtimes record a loud no-op in the flight ring
+        # rather than failing the serve loop.
+        if self.path.partition("?")[0].rstrip("/") != "/profile":
+            self.send_error(404)
+            return
+        if self.generator.engine is None:
+            self.send_error(404)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = json.loads(self.rfile.read(length) or b"{}")
+        except (json.JSONDecodeError, ValueError):
+            # ValueError also covers a malformed Content-Length header
+            self._respond("invalid json", 400)
+            return
+        if not isinstance(raw, dict):
+            # valid JSON that is not an object ('5', '[1]') must be a
+            # 400, not an AttributeError in the handler thread
+            self._respond({"message": "body must be a JSON object"}, 400)
+            return
+        rounds = raw.get("rounds", 16)
+        trace_dir = raw.get("trace_dir")
+        if not isinstance(rounds, int) or rounds < 1:
+            self._respond({"message": "rounds must be an integer >= 1"},
+                          400)
+            return
+        try:
+            res = self.generator.engine.request_profile(
+                rounds, trace_dir=trace_dir)
+        except Exception as e:  # noqa: BLE001 — same jsonified contract
+            self._respond({"message": repr(e)}, 500)
+            return
+        self._respond(res, 200 if res.get("ok") else 409)
 
     def do_PUT(self):
         if self.path.rstrip("/") != "/api":
@@ -585,8 +717,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Connection", "close")
             self.end_headers()
 
-        def write_event(obj):
-            self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
+        def write_event(obj, rid=None):
+            # the SSE `id:` field carries the engine request id (ISSUE
+            # 13): a client-visible stall greps by this id straight to
+            # the engine rounds (trace spans, flight-record events) it
+            # spanned; EventSource clients surface it as lastEventId
+            prefix = f"id: {rid}\n" if rid is not None else ""
+            self.wfile.write(
+                (prefix + f"data: {json.dumps(obj)}\n\n").encode())
             self.wfile.flush()
 
         try:

@@ -1,0 +1,44 @@
+"""Flight-recorder telemetry (ISSUE 13): structured tracing, Prometheus
+metrics, and postmortem recording across the train and serve hot paths.
+
+Three pieces, one contract:
+
+- `trace.SpanTracer` — nestable host-side spans with request-id /
+  train-step correlation, exported as Chrome trace-event JSON
+  (Perfetto);
+- `recorder.FlightRecorder` — a bounded ring of structured events +
+  counter snapshots, auto-dumped to a JSON artifact on engine poison,
+  watchdog rollback and SIGTERM emergency save;
+- `prometheus.Histogram` / `render_prometheus` — real histogram metrics
+  (TTFT, decode-round ms, queue wait, step ms) behind the
+  content-negotiated Prometheus text exposition on GET /metrics.
+
+The contract that keeps this subsystem honest: ALL emission stays
+outside jitted code. Telemetry-on steps are bitwise-identical to
+telemetry-off — pinned by tests/test_telemetry.py AND by the
+graft-check audit (telemetry-on engine / train.step specializations
+lower to the same collective inventory with zero host callbacks), and
+the emit methods sit on graft-check GR006 HOT_PATHS so a device sync
+can never creep into per-round bookkeeping.
+"""
+
+from megatron_llm_tpu.telemetry.prometheus import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    PROMETHEUS_CONTENT_TYPE,
+    Histogram,
+    parse_prometheus,
+    render_prometheus,
+)
+from megatron_llm_tpu.telemetry.recorder import FlightRecorder
+from megatron_llm_tpu.telemetry.trace import NULL_TRACER, SpanTracer
+
+__all__ = [
+    "SpanTracer",
+    "NULL_TRACER",
+    "FlightRecorder",
+    "Histogram",
+    "DEFAULT_LATENCY_BUCKETS_MS",
+    "PROMETHEUS_CONTENT_TYPE",
+    "render_prometheus",
+    "parse_prometheus",
+]

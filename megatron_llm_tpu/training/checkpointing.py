@@ -260,10 +260,15 @@ class CheckpointManager:
     process exit so the final save commits."""
 
     def __init__(self, save_dir: str, keep_latest_n: Optional[int] = None,
-                 async_save: bool = True):
+                 async_save: bool = True, recorder=None):
         self.save_dir = os.path.abspath(save_dir)
         self.keep_latest_n = keep_latest_n
         self.async_save = async_save
+        # optional telemetry.FlightRecorder (ISSUE 13): the save
+        # lifecycle (dispatch + blocked ms, background certification)
+        # lands in the flight ring keyed by iteration — a postmortem
+        # shows whether the run died inside/behind a save
+        self.recorder = recorder
         self._model_ckptr = ocp.StandardCheckpointer()
         self._optim_ckptr = ocp.StandardCheckpointer()
         self._finalizer: Optional[threading.Thread] = None
@@ -311,7 +316,12 @@ class CheckpointManager:
                     gc_checkpoints(
                         self.save_dir, self.keep_latest_n,
                         protect=self._protected | {path})
+            if self.recorder is not None:
+                self.recorder.record("ckpt_certified", step=iteration)
         except BaseException as e:  # surfaced on the next save()/wait()
+            if self.recorder is not None:
+                self.recorder.record("ckpt_failed", step=iteration,
+                                     error=repr(e))
             self._error = e
 
     def save(
@@ -344,6 +354,10 @@ class CheckpointManager:
             self.last_blocked_ms = (time.perf_counter() - t0) * 1e3
             self.total_blocked_ms += self.last_blocked_ms
             self.saves += 1
+            if self.recorder is not None:
+                self.recorder.record(
+                    "ckpt_certified", step=iteration,
+                    blocked_ms=round(self.last_blocked_ms, 3))
             return out
         # async: these return after the device→host copy; tensorstore
         # writes + the directory rename happen on orbax's threads
@@ -362,6 +376,10 @@ class CheckpointManager:
         self.last_blocked_ms = (time.perf_counter() - t0) * 1e3
         self.total_blocked_ms += self.last_blocked_ms
         self.saves += 1
+        if self.recorder is not None:
+            self.recorder.record(
+                "ckpt_dispatched", step=iteration,
+                blocked_ms=round(self.last_blocked_ms, 3))
         return path
 
 

@@ -14,11 +14,16 @@ from typing import List, Optional
 
 
 class _Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, tracer=None):
         self.name = name
         self._elapsed = 0.0
         self._started = False
         self._start_time = 0.0
+        # span-tracer ride-along (ISSUE 13): every start/stop interval
+        # of a named timer also lands on the Perfetto timeline, so the
+        # existing instrumentation points (train-step, batch-generator,
+        # save-checkpoint, ...) need no second set of emit sites
+        self._tracer = tracer
 
     def start(self):
         assert not self._started, f"timer {self.name} already started"
@@ -27,8 +32,11 @@ class _Timer:
 
     def stop(self):
         assert self._started, f"timer {self.name} not started"
-        self._elapsed += time.perf_counter() - self._start_time
+        now = time.perf_counter()
+        self._elapsed += now - self._start_time
         self._started = False
+        if self._tracer is not None:
+            self._tracer.complete(self.name, self._start_time, now)
 
     def reset(self):
         self._elapsed = 0.0
@@ -37,7 +45,11 @@ class _Timer:
     def elapsed(self, reset: bool = True) -> float:
         started = self._started
         if started:
+            # the internal stop/start pair is bookkeeping, not a real
+            # interval — it must not emit a trace span
+            tracer, self._tracer = self._tracer, None
             self.stop()
+            self._tracer = tracer
         total = self._elapsed
         if reset:
             self.reset()
@@ -50,9 +62,13 @@ class Timers:
     """ref: Timers (timers.py:120-307); log_option max/minmax/all collapse
     to the single-process value in the single-controller runtime."""
 
-    def __init__(self, log_level: int = 0, log_option: str = "minmax"):
+    def __init__(self, log_level: int = 0, log_option: str = "minmax",
+                 tracer=None):
         self._log_level = log_level
         self._log_option = log_option
+        # optional telemetry.SpanTracer: timer intervals double as
+        # trace spans (the trainer passes its tracer; None = no spans)
+        self._tracer = tracer
         self._timers: dict = {}
         self._log_levels: dict = {}
         # one-shot run facts (remat policy, compiled temp/args bytes, ...)
@@ -75,7 +91,7 @@ class Timers:
 
     def __call__(self, name: str, log_level: Optional[int] = None) -> _Timer:
         if name not in self._timers:
-            self._timers[name] = _Timer(name)
+            self._timers[name] = _Timer(name, tracer=self._tracer)
             self._log_levels[name] = log_level if log_level is not None else 0
         return self._timers[name]
 

@@ -48,6 +48,13 @@ from megatron_llm_tpu.optimizer.optimizer import OptimizerState, optimizer_step
         # pure-dp replicated adam: the dp grad reduction + scalar
         # reductions are the only collectives
         "dp2": frozenset({"all-reduce"}),
+        # telemetry-on specialization (ISSUE 13): by contract IDENTICAL
+        # to dp2 — span/recorder emission is host bookkeeping outside
+        # the jit, so the lowered artifact may not change by one op.
+        # The audit lowers this row with a live tracer+recorder around
+        # the mint and _check_telemetry_parity pins inventory equality
+        # + zero host callbacks vs the telemetry-off dp2 row.
+        "dp2+telemetry": frozenset({"all-reduce"}),
         # ZeRO-1 explicit decomposition (optimizer/zero1.py): the ISSUE
         # 10 contract — per-bucket reduce-scatter of grads, all-gather
         # of updated params, all-reduce for loss/denominator/grad-norm

@@ -141,7 +141,30 @@ class Trainer:
         self.reset_position_ids = reset_position_ids
         self.reset_attention_mask = reset_attention_mask
         self.eod_mask_loss = eod_mask_loss
-        self.timers = Timers(tcfg.timing_log_level, tcfg.timing_log_option)
+        # flight-recorder telemetry (ISSUE 13): the tracer is enabled
+        # only with --trace_dir (Chrome trace JSON exported at the end
+        # of train(); the named timers double as its spans); the flight
+        # recorder is ALWAYS on — a bounded ring of per-step events +
+        # watchdog/checkpoint lifecycle, auto-dumped on watchdog
+        # rollback and the SIGTERM emergency save (into
+        # --flight_record_dir, default the --save dir). Emission is
+        # host bookkeeping only: telemetry-on steps are bitwise
+        # telemetry-off (tests/test_telemetry.py pins it).
+        from megatron_llm_tpu.telemetry import (
+            NULL_TRACER,
+            FlightRecorder,
+            Histogram,
+            SpanTracer,
+        )
+
+        self.tracer = (SpanTracer(enabled=True) if tcfg.trace_dir
+                       else NULL_TRACER)
+        self.recorder = FlightRecorder(tcfg.flight_recorder_size)
+        self._step_ms_hist = Histogram(
+            "train_step_ms", help_text="wall ms per optimizer step "
+            "(dispatch + loss fetch)")
+        self.timers = Timers(tcfg.timing_log_level, tcfg.timing_log_option,
+                             tracer=self.tracer)
         self._n_params = 0  # set in setup(); enables the TFLOP/s log field
         self._trace_active = False
         self._run_facts_logged = False
@@ -201,6 +224,7 @@ class Trainer:
             k_sigma=tcfg.loss_watchdog_ksigma,
             window=max(tcfg.loss_watchdog_window, 4),
             patience=tcfg.spike_rollback_patience,
+            recorder=self.recorder,
         )
         self._dropout_base_rng: Optional[jax.Array] = None
         self._autoresume = None
@@ -719,9 +743,16 @@ class Trainer:
             self._ckpt_manager = CheckpointManager(
                 self.tcfg.save, keep_latest_n=self.tcfg.keep_latest_n,
                 async_save=self.tcfg.async_save,
+                recorder=self.recorder,
             )
             self._ckpt_manager.protect(self._loaded_ckpt_path)
         return self._ckpt_manager
+
+    def _flight_record_dir(self):
+        """Where flight-record artifacts land: --flight_record_dir,
+        falling back to the --save dir (the place a postmortem already
+        looks); None = in-memory + log-summary only."""
+        return self.tcfg.flight_record_dir or self.tcfg.save
 
     def _save(self, state: TrainState, blocking: bool = False):
         """Interval save: async by default — the loop stalls only for
@@ -742,6 +773,10 @@ class Trainer:
         )
         self.timers("save-checkpoint").stop()
         self.timers.gauge("ckpt_blocked_ms", round(mgr.last_blocked_ms, 2))
+        # the save's loop stall on the trace timeline, step-correlated
+        # (the save-checkpoint timer span carries the full dispatch)
+        self.tracer.instant("ckpt_blocked",
+                            blocked_ms=round(mgr.last_blocked_ms, 3))
         if blocking:
             mgr.wait_until_finished()
         print(f"saved checkpoint at iteration {state.iteration} to "
@@ -795,7 +830,19 @@ class Trainer:
         if meta.get("scheduler"):
             self.scheduler.load_state_dict(meta["scheduler"])
         self._get_ckpt_manager().protect(meta.get("loaded_path"))
-        self.watchdog.note_rollback()
+        self.watchdog.note_rollback(step=iteration + poison,
+                                    restored_step=iteration)
+        self.tracer.instant("watchdog_rollback", restored_step=iteration,
+                            poison_window=poison)
+        # flight-recorder postmortem artifact (ISSUE 13): the verdict
+        # trail + per-step record that led to this rollback, dumped
+        # BEFORE training resumes — the artifact names the failing
+        # step range even if the run later dies for another reason
+        self.recorder.dump(
+            self._flight_record_dir(), "watchdog-rollback",
+            extra={"restored_step": iteration,
+                   "poison_window": poison,
+                   "rollback": self.watchdog.rollbacks})
         print(f"LOSS WATCHDOG ROLLBACK: reloaded iteration {iteration} "
               f"from {self.tcfg.save}; data iterator fast-forwarded past "
               f"the {poison}-iteration poison window "
@@ -824,6 +871,11 @@ class Trainer:
 
         last_log_time = time.time()
         while keep_going():
+            # every span this iteration emits (batch-generator,
+            # train-step, save-checkpoint via the timers ride-along)
+            # carries the step it belongs to — the trace-side half of
+            # the rid/step correlation model (ISSUE 13)
+            self.tracer.set_context(step=state.iteration + 1)
             self.timers("batch-generator").start()
             try:
                 text = next(data_iter)
@@ -854,6 +906,12 @@ class Trainer:
             self.timers("train-step").stop()
             stats["loss"] = loss_val
             elapsed = time.time() - t0
+            # flight-recorder step trail + the step-ms histogram
+            # (host floats only — the loss was already fetched above)
+            self._step_ms_hist.observe(elapsed * 1e3)
+            self.recorder.record("step", step=state.iteration,
+                                 loss=loss_val,
+                                 ms=round(elapsed * 1e3, 3))
             if self._trace_active and state.iteration >= tcfg.profile_step_end:
                 jax.profiler.stop_trace()
                 self._trace_active = False
@@ -862,7 +920,9 @@ class Trainer:
             # already SKIPPED on device by the spike-threshold gate; the
             # host side counts the streak and escalates to a rollback
             # after `spike_rollback_patience` consecutive bad steps.
-            if self.watchdog.observe(loss_val):
+            if self.watchdog.observe(loss_val, step=state.iteration):
+                self.tracer.instant("watchdog_bad", loss=loss_val,
+                                    streak=self.watchdog.consecutive_bad)
                 print(f"loss watchdog: bad step at iteration "
                       f"{state.iteration} (loss {loss_val:.6E}, "
                       f"threshold {self.watchdog.threshold():.6E}, "
@@ -918,7 +978,17 @@ class Trainer:
                     # exits as one.
                     print("exiting on termination signal — emergency "
                           "save", flush=True)
+                    self.recorder.record("sigterm", step=state.iteration)
                     self._save(state, blocking=True)
+                    # postmortem artifact AFTER the committed save (the
+                    # save dir now exists even on a first-interval
+                    # kill): the killed run's last-N-steps record,
+                    # correlated to the emergency-saved iteration
+                    self.recorder.dump(
+                        self._flight_record_dir(), "sigterm",
+                        extra={"step": state.iteration,
+                               "consumed_train_samples":
+                                   state.consumed_train_samples})
                     host_barrier("emergency-save-done")
                     break
             if tcfg.exit_duration_in_mins is not None:
@@ -947,6 +1017,12 @@ class Trainer:
         # in-flight interval save must land before the process may die.
         if self._ckpt_manager is not None:
             self._ckpt_manager.wait_until_finished()
+        if self.tcfg.trace_dir:
+            path = self.tracer.export(os.path.join(
+                self.tcfg.trace_dir, f"trace_train_{os.getpid()}.json"))
+            if path:
+                print(f"span trace exported to {path} "
+                      f"(Perfetto / chrome://tracing)", flush=True)
         return state
 
 

@@ -40,10 +40,16 @@ class LossWatchdog:
     `patience <= 0` disables rollback escalation (skip-only mode)."""
 
     def __init__(self, k_sigma: float = 0.0, window: int = 64,
-                 patience: int = 0, min_history: int = 8):
+                 patience: int = 0, min_history: int = 8,
+                 recorder=None):
         assert window >= 4 and min_history >= 2
         self.k_sigma = k_sigma
         self.patience = patience
+        # optional telemetry.FlightRecorder (ISSUE 13): every BAD
+        # verdict and every rollback lands in the flight ring keyed by
+        # step, so a dumped artifact shows the verdict trail that led
+        # to the death/rollback — not just the final counter values
+        self.recorder = recorder
         # a window smaller than min_history could never arm the
         # threshold (the deque caps below it) — clamp so every accepted
         # window size actually detects spikes
@@ -77,15 +83,22 @@ class LossWatchdog:
 
     # -- per-step protocol -------------------------------------------------
 
-    def observe(self, loss: float) -> bool:
+    def observe(self, loss: float, step: int = -1) -> bool:
         """Feed one step's loss; returns True when the step was BAD
         (non-finite or spiking) — the trainer's in-step threshold already
         skipped the update for exactly these steps, so the watchdog and
-        the device agree by construction (same threshold value)."""
-        bad = (not math.isfinite(loss)) or loss > self.threshold()
+        the device agree by construction (same threshold value).
+        `step` is the correlation key the flight-record verdict events
+        carry (the trainer passes its iteration)."""
+        thr = self.threshold()
+        bad = (not math.isfinite(loss)) or loss > thr
         if bad:
             self.consecutive_bad += 1
             self.skipped += 1
+            if self.recorder is not None:
+                self.recorder.record(
+                    "watchdog_bad", step=step, loss=loss,
+                    threshold=thr, streak=self.consecutive_bad)
         else:
             self.consecutive_bad = 0
             self._window.append(loss)
@@ -94,13 +107,18 @@ class LossWatchdog:
     def should_rollback(self) -> bool:
         return self.patience > 0 and self.consecutive_bad >= self.patience
 
-    def note_rollback(self) -> None:
+    def note_rollback(self, step: int = -1,
+                      restored_step: int = -1) -> None:
         """Reset after the trainer reloaded a checkpoint: the window is
         cleared (it described the diverged trajectory, not the restored
         one) and the bad-streak ends."""
         self.rollbacks += 1
         self.consecutive_bad = 0
         self._window.clear()
+        if self.recorder is not None:
+            self.recorder.record("watchdog_rollback", step=step,
+                                 restored_step=restored_step,
+                                 rollback=self.rollbacks)
 
     def counters(self) -> dict:
         return {"loss_watchdog_skipped": self.skipped,

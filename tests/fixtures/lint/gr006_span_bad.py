@@ -1,0 +1,28 @@
+"""GR006 span-emission fixture (ISSUE 13): telemetry bookkeeping on a
+hot per-round path that SYNCS THE DEVICE to decorate its spans/events.
+The test monkeypatches lint.HOT_PATHS to scope `Tracer.complete` and
+`Recorder.record` hot — in the real repo that list is
+telemetry/trace.py SpanTracer.*, recorder.py FlightRecorder.record and
+prometheus.py Histogram.observe: emission must consume host scalars the
+scheduler already holds, never fetch its own."""
+import time
+
+import jax
+import numpy as np
+
+
+class Tracer:
+    def complete(self, name, t0, t1, logits=None, toks=None):
+        # span args fetched from device INSIDE the emit path: every
+        # round now pays a transfer for a label nobody may ever read
+        args = {"first": float(logits[0])}  # LINT
+        args["toks"] = np.asarray(toks)  # LINT
+        self_events = (name, t0, t1, args)
+        return self_events
+
+
+class Recorder:
+    def record(self, kind, loss=None):
+        jax.device_get(loss)  # LINT
+        loss.block_until_ready()  # LINT
+        return (time.time(), kind)

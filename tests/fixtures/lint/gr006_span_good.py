@@ -1,0 +1,28 @@
+"""GR006 span-emission counterpart (ISSUE 13): telemetry emit paths do
+pure host bookkeeping — clock reads, dict literals, ring appends — on
+values the caller ALREADY fetched for its own scheduling decisions.
+This is the telemetry/ package's pattern: telemetry-on rounds stay
+bitwise telemetry-off because emission never touches a device value."""
+import time
+from collections import deque
+
+
+class Tracer:
+    def __init__(self):
+        self._events = deque(maxlen=1024)
+
+    def complete(self, name, t0, t1, **args):
+        # args arrive as host scalars (the scheduler's own ints/floats:
+        # rid, round, token counts) — emission is one append
+        self._events.append({"name": name, "ph": "X",
+                             "ts": round(t0 * 1e6),
+                             "dur": round((t1 - t0) * 1e6),
+                             "args": args})
+
+
+class Recorder:
+    def __init__(self):
+        self._events = deque(maxlen=1024)
+
+    def record(self, kind, **fields):
+        self._events.append({"t": time.time(), "kind": kind, **fields})

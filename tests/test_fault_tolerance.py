@@ -516,6 +516,28 @@ def test_watchdog_rollback_end_to_end(tmp_path):
     # 20 batches consumed = 10 good + 2 poison-skipped + 8 post-rollback
     assert state.consumed_train_samples == 20 * 2
 
+    # flight-recorder rollback artifact (ISSUE 13): the rollback left a
+    # JSON record in the save dir whose verdict trail names the exact
+    # failing steps and the restored iteration — loadable + correlated
+    # by step id, not a log tail
+    import glob
+
+    arts = glob.glob(os.path.join(
+        save_dir, "flight_record_watchdog-rollback_*.json"))
+    assert arts, sorted(os.listdir(save_dir))
+    with open(arts[0]) as f:
+        rec = json.load(f)
+    assert rec["reason"] == "watchdog-rollback"
+    assert rec["extra"]["restored_step"] == 10
+    assert rec["extra"]["poison_window"] == 2
+    bad = [e for e in rec["events"] if e["kind"] == "watchdog_bad"]
+    assert [e["step"] for e in bad] == [11, 12], bad
+    assert any(e["kind"] == "watchdog_rollback"
+               and e["restored_step"] == 10 for e in rec["events"])
+    # the per-step trail brackets the poison window
+    rec_steps = [e["step"] for e in rec["events"] if e["kind"] == "step"]
+    assert 10 in rec_steps and 11 in rec_steps and 12 in rec_steps
+
 
 def test_rollback_with_no_save_optim(tmp_path, capsys):
     """--no_save_optim checkpoints have no optim dir; rollback must
@@ -638,6 +660,31 @@ def test_kill_and_resume_bitwise(tmp_path):
         f"5-step overlap; raise TRAIN_ITERS"
     # the emergency save certified a checkpoint at the killed iteration
     assert read_tracker(os.path.join(kill_dir, "ckpt")) == (k, False)
+
+    # flight-recorder artifact (ISSUE 13): the killed run left a
+    # readable last-N-steps record that correlates to the emergency-
+    # saved iteration by step id — the postmortem starts from this
+    # JSON, not a log tail
+    import glob
+
+    arts = glob.glob(os.path.join(kill_dir, "ckpt",
+                                  "flight_record_sigterm_*.json"))
+    assert arts, sorted(os.listdir(os.path.join(kill_dir, "ckpt")))
+    with open(arts[0]) as f:
+        rec = json.load(f)
+    assert rec["reason"] == "sigterm"
+    assert rec["extra"]["step"] == k
+    rec_steps = [e for e in rec["events"] if e["kind"] == "step"]
+    assert rec_steps, rec["events"]
+    assert rec_steps[-1]["step"] == k
+    # the recorded per-step losses match the on-disk loss log for the
+    # overlapping steps (the record is the run, not a reconstruction)
+    kill_losses = _read_losses(kill_dir)
+    for e in rec_steps:
+        assert float.hex(e["loss"]) == kill_losses[e["step"]], e
+    assert any(e["kind"] == "sigterm" for e in rec["events"])
+    assert any(e["kind"] == "ckpt_certified" and e["step"] == k
+               for e in rec["events"])
 
     # 3) fresh process auto-resumes from the emergency save
     r2 = subprocess.run(

@@ -92,6 +92,55 @@ class TestLintRules:
         findings, _ = _lint_fixture(name, rule, monkeypatch)
         assert findings == [], [f.to_dict() for f in findings]
 
+    def test_gr006_span_emission_fixtures(self, monkeypatch):
+        """ISSUE 13: telemetry emission on a hot round/step path must be
+        pure host bookkeeping. The bad fixture syncs the device to
+        decorate its spans/events (fires exactly on the marked lines);
+        the good fixture is the telemetry/ package's pattern — clock
+        reads + ring appends on already-fetched host scalars (quiet)."""
+        hot = {"Tracer.complete", "Recorder.record"}
+        for name, expect_fire in (("gr006_span_bad.py", True),
+                                  ("gr006_span_good.py", False)):
+            src = _read_fixture(name)
+            monkeypatch.setitem(lint.HOT_PATHS, name, hot)
+            findings = lint.lint_source(src, name)
+            marked = {i for i, ln in enumerate(src.splitlines(), 1)
+                      if "# LINT" in ln}
+            got = {f.line for f in findings if f.rule == "GR006"}
+            if expect_fire:
+                assert got == marked and marked, (
+                    f"{name}: GR006 fired on {sorted(got)}, marks "
+                    f"{sorted(marked)}")
+                assert {f.rule for f in findings} == {"GR006"}, [
+                    f.to_dict() for f in findings]
+            else:
+                assert findings == [], [f.to_dict() for f in findings]
+
+    def test_telemetry_emit_sites_are_hot_paths(self):
+        """The GR006 scope covers the telemetry emit sites (ISSUE 13):
+        a device sync added to span/event/histogram emission — code
+        that runs per round/step — must fail the lint gate, and the
+        real modules must currently be clean under that scope."""
+        for path, needed in (
+            ("megatron_llm_tpu/telemetry/trace.py",
+             {"SpanTracer.complete", "SpanTracer.instant",
+              "_Span.__exit__"}),
+            ("megatron_llm_tpu/telemetry/recorder.py",
+             {"FlightRecorder.record"}),
+            ("megatron_llm_tpu/telemetry/prometheus.py",
+             {"Histogram.observe"}),
+            ("megatron_llm_tpu/inference/engine.py",
+             {"DecodeEngine.step", "DecodeEngine._step_inner"}),
+        ):
+            assert needed <= lint.HOT_PATHS.get(path, set()), (
+                path, needed)
+        findings = lint.lint_paths(
+            [os.path.join(_REPO, "megatron_llm_tpu", "telemetry", f)
+             for f in ("trace.py", "recorder.py", "prometheus.py")],
+            _REPO)
+        assert [f for f in findings if f.rule == "GR006"] == [], [
+            f.to_dict() for f in findings]
+
     def test_finding_keys_are_line_number_free(self):
         """Pure code motion (leading blank lines) must not churn the
         baseline: keys carry qualname+detail+ordinal, never line."""

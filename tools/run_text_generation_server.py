@@ -99,6 +99,25 @@ def main():
                         "per-output-channel quantization of the decode "
                         "qkv/dense/MLP weights (halves decode weight "
                         "traffic; fp checkpoint untouched; decode-only)")
+    # ISSUE 13 observability (docs/GUIDE.md "Observability"): host span
+    # tracing, the flight-recorder crash artifact, and the jax.profiler
+    # capture hook (POST /profile). GET /metrics always serves both the
+    # legacy JSON and — under Accept: text/plain / ?format=prometheus —
+    # the Prometheus text exposition with real latency histograms.
+    p.add_argument("--trace_dir", type=str, default=None,
+                   help="enable the engine's host span tracer; Chrome "
+                        "trace-event JSON (Perfetto) exports here on "
+                        "shutdown, and POST /profile captures default "
+                        "here")
+    p.add_argument("--record_dir", type=str, default=".",
+                   help="where the flight recorder dumps its crash "
+                        "artifact when the serve loop dies poisoned "
+                        "(default: the working directory; the live "
+                        "snapshot is always at GET /flight_record)")
+    p.add_argument("--flight_recorder_size", type=int, default=4096,
+                   help="bounded ring of recent structured engine "
+                        "events (rounds, admissions, retirements) the "
+                        "flight recorder keeps")
     args = p.parse_args()
 
     import jax
@@ -173,6 +192,9 @@ def main():
             quantize_weights=args.quantize_weights,
             termination_id=tokenizer.eod,
             vocab_size=tokenizer.vocab_size,
+            trace_dir=args.trace_dir,
+            record_dir=args.record_dir,
+            flight_recorder_size=args.flight_recorder_size,
         )
     print(f"serving {args.model} from {path} on "
           f"http://{args.host}:{args.port}/api"
@@ -190,7 +212,11 @@ def main():
              + (f", spec decode k={engine.spec_decode_k}"
                 if engine.spec_decode_k else "")
              + (", SSE streaming" if args.stream else "")
-             + ", counters at /metrics, health at /health)"
+             + (f", span tracing -> {args.trace_dir}"
+                if args.trace_dir else "")
+             + ", counters at /metrics (JSON + Prometheus), health at "
+               "/health, flight record at /flight_record, profiler at "
+               "POST /profile)"
              if engine else " (whole-batch, no engine)"), flush=True)
     MegatronServer(model, params, tokenizer, engine=engine,
                    request_deadline_s=args.request_deadline_s,
