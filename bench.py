@@ -48,6 +48,15 @@ and max teacher-forced prompt-logprob drift vs bf16 stated in-row; the
 decode roofline row now derives cache bytes from the active cache
 dtype instead of hard-coding bf16.
 
+Round-14 audit keys (ISSUE 14): `extra.serving.scaleout` scales the
+engine OUT — N emulated prefix-cache replicas (each pinned to its own
+device) behind the prefix-affinity router (inference/router.py) vs the
+same fleet under seeded-random dispatch vs a 1-replica baseline, on
+the 80%-shared-system-prompt mix: aggregate tok/s and TTFT p50/p95 per
+arm, `router_affinity_vs_random_ttft_p95` and
+`aggregate_tok_s_scaling` headlines, fleet prefill-token reduction,
+methodology in-row (CPU-harness-tested in tests/test_router.py).
+
 Round-13 audit keys (ISSUE 13): `extra.telemetry` prices the
 flight-recorder telemetry — span tracing + histograms + recorder ON vs
 OFF on identical serving and training traffic, `telemetry_overhead_pct`
@@ -604,6 +613,138 @@ def serving_prefix_stats(model, params, *, slots=4, page_size=64,
     }
 
 
+def serving_scaleout_stats(model, params, *, replicas=2, slots=2,
+                           page_size=64, max_context=768, chunk=128,
+                           vocab_size=32000, n_requests=24,
+                           shared_frac=0.8, sys_prompt=384,
+                           uniq_suffix=32, gen=32, step_horizon=8,
+                           devices=None):
+    """The `extra.serving.scaleout` harness (ISSUE 14): N emulated
+    engine replicas behind the prefix-affinity router
+    (inference/router.py) vs the SAME fleet under seeded-random
+    dispatch, plus a 1-replica baseline, all on the
+    80%-shared-system-prompt mix. Methodology (stated in the emitted
+    row): each replica is an independent prefix-cache DecodeEngine
+    pinned to its own device (true compute parallelism where the host
+    has >= N devices; the row records the device list honestly), each
+    fleet is compile-warmed off the clock with a COLD prefix cache and
+    cold router index at t0, and the identical greedy burst submits
+    through the router. Headlines:
+    `router_affinity_vs_random_ttft_p95` (> 1 means affinity routing
+    beat random dispatch on p95 TTFT — affinity lands every shared
+    prefix on the replica already holding its pages, random scatters
+    it and each replica re-prefills) and `aggregate_tok_s_scaling`
+    (fleet tok/s over the 1-replica baseline — near N on
+    N-device hosts, where replica compute genuinely overlaps)."""
+    import numpy as np
+
+    from megatron_llm_tpu.inference.engine import DecodeEngine
+    from megatron_llm_tpu.inference.router import (
+        EngineReplica,
+        ReplicaRouter,
+    )
+
+    rs = np.random.RandomState(0)
+    sysp = list(rs.randint(2, vocab_size, sys_prompt))
+    uniq_every = max(int(round(1.0 / max(1.0 - shared_frac, 1e-9))), 1)
+    work = []
+    n_shared = 0
+    for i in range(n_requests):
+        if (i % uniq_every) != uniq_every - 1:
+            work.append(sysp + list(rs.randint(2, vocab_size,
+                                               uniq_suffix)))
+            n_shared += 1
+        else:
+            work.append(list(rs.randint(2, vocab_size,
+                                        sys_prompt + uniq_suffix)))
+    devs = list(devices) if devices is not None else list(jax.devices())
+    pct = DecodeEngine._pct
+
+    def run_fleet(n, affinity, fallback):
+        engines = []
+        for i in range(n):
+            eng = DecodeEngine(
+                model, params, slots=slots, page_size=page_size,
+                max_context=max_context, max_queue=n_requests,
+                termination_id=None, vocab_size=vocab_size,
+                prefill_chunk_tokens=chunk, prefix_cache=True,
+                step_horizon=step_horizon, replica_id=i,
+                devices=[devs[i % len(devs)]])
+            # compile-warm off the clock; measured run starts with a
+            # cold prefix cache (the first shared admission per
+            # replica pays its full prefill honestly in-run)
+            eng.warmup()
+            eng.reset_prefix_cache()
+            engines.append(eng)
+        router = ReplicaRouter(
+            [EngineReplica(e) for e in engines], affinity=affinity,
+            fallback=fallback, rng_seed=1)
+        router.start()
+        t0 = time.perf_counter()
+        reqs = [router.submit(p, gen, top_k=1) for p in work]
+        for r in reqs:
+            r.result(timeout=600.0)
+        makespan = max(r.t_done for r in reqs) - t0
+        ttfts = sorted((r.t_first - r.t_submit) * 1e3 for r in reqs)
+        stats = router.router_stats()
+        prefix_hits = sum(e.counters().get("serve_prefix_hits", 0)
+                          for e in engines)
+        prefill_tokens = sum(e.counters()["serve_prefill_tokens"]
+                             for e in engines)
+        router.stop(drain=True)
+        return {
+            "replicas": n,
+            "affinity": affinity,
+            "fallback": fallback,
+            "aggregate_tok_s": round(n_requests * gen / makespan, 1),
+            "ttft_p50_ms": round(pct(ttfts, 0.50), 2),
+            "ttft_p95_ms": round(pct(ttfts, 0.95), 2),
+            "affinity_hit_rate": stats["router_affinity_hit_rate"],
+            "failovers": stats["router_failovers"],
+            "per_replica_dispatches": stats[
+                "router_per_replica_dispatches"],
+            "prefix_hits": int(prefix_hits),
+            "prefill_tokens": int(prefill_tokens),
+        }
+
+    aff = run_fleet(replicas, True, "least_loaded")
+    rnd = run_fleet(replicas, False, "random")
+    base = run_fleet(1, True, "least_loaded")
+    return {
+        "replicas": replicas,
+        "n_requests": n_requests,
+        "shared_requests": n_shared,
+        "devices": [str(d) for d in devs[:replicas]],
+        "affinity": aff,
+        "random": rnd,
+        "single_replica": base,
+        "router_affinity_vs_random_ttft_p95": round(
+            rnd["ttft_p95_ms"] / max(aff["ttft_p95_ms"], 1e-9), 2),
+        "affinity_vs_random_prefill_tokens": round(
+            rnd["prefill_tokens"] / max(aff["prefill_tokens"], 1), 2),
+        "aggregate_tok_s_scaling": round(
+            aff["aggregate_tok_s"]
+            / max(base["aggregate_tok_s"], 1e-9), 2),
+        "methodology": (
+            f"identical greedy burst through the router 3 ways: "
+            f"{replicas}-replica affinity (least-loaded fallback), "
+            f"{replicas}-replica seeded-random dispatch (the control "
+            f"arm), 1-replica baseline; {n_shared}/{n_requests} "
+            f"requests = {sys_prompt}-token shared system prompt + "
+            f"{uniq_suffix} unique tokens, the rest fully unique at "
+            f"the same length; every replica an independent "
+            f"prefix-cache engine pinned to its own device (devices "
+            f"listed in-row — scaling is only meaningful where "
+            f"replicas own distinct chips), compile-warmed off the "
+            f"clock, prefix cache + router index cold at t0; TTFT = "
+            f"submit -> first generated token via the replica serve "
+            f"loops; aggregate tok/s = requested gen tokens / fleet "
+            f"makespan; scaling = fleet tok/s over the 1-replica "
+            f"baseline on the same workload"
+        ),
+    }
+
+
 def quant_paged_op_stats(slots=8, T=512, page_size=64):
     """Standalone paged decode-attention op, bf16 vs int8 pools at the
     SAME traffic (same slots, same per-slot lengths, same page tables):
@@ -790,6 +931,7 @@ def run_serving(n_requests=16, slots=8):
     stats = serving_stats(model, params, work, arrivals, slots=slots)
     stats["interference"] = serving_interference_stats(model, params)
     stats["prefix"] = serving_prefix_stats(model, params)
+    stats["scaleout"] = serving_scaleout_stats(model, params)
     return stats
 
 
@@ -1625,6 +1767,15 @@ def main():
             f"prefill tokens/request "
             f"-{serving['prefix']['prefill_token_reduction']:.0%}, "
             f"peak pages -{serving['prefix']['peak_pages_in_use_delta']}"
+            f"; replica router at "
+            f"{serving['scaleout']['replicas']} emulated replicas "
+            f"(80%-shared mix): affinity vs random dispatch p95 TTFT "
+            f"{serving['scaleout']['router_affinity_vs_random_ttft_p95']}"
+            f"x, fleet prefill tokens /"
+            f"{serving['scaleout']['affinity_vs_random_prefill_tokens']}"
+            f", aggregate tok/s "
+            f"{serving['scaleout']['aggregate_tok_s_scaling']}x the "
+            f"1-replica baseline"
             f"; int8 KV pages: "
             f"{quant['int8_vs_bf16_decode_tok_s']}x decode tok/s, "
             f"{quant['kv_capacity_ratio']}x tokens/HBM-byte "

@@ -19,12 +19,16 @@ a production shape exists.
 
 Entry points audited (the registry's lowerable surface):
 - the five engine builders, through `DecodeEngine.audit_entry_points()`
-  against the engine's REAL pools (mesh tag "single") — THRICE: an fp
-  engine, an int8-KV + weight-only-int8 engine (ISSUE 9), and a
-  telemetry-on engine (ISSUE 13: live span tracer + flight recorder
-  around the mint; _check_telemetry_parity pins its artifacts
-  identical to the fp engine's — inventory equality, zero host
-  callbacks — so telemetry can never leak into jitted code);
+  against the engine's REAL pools — FOUR times: an fp engine, an
+  int8-KV + weight-only-int8 engine (ISSUE 9), and a telemetry-on
+  engine (ISSUE 13: live span tracer + flight recorder around the
+  mint; _check_telemetry_parity pins its artifacts identical to the fp
+  engine's — inventory equality, zero host callbacks — so telemetry
+  can never leak into jitted code), all at mesh tag "single"; plus a
+  tp2-MESH engine (ISSUE 14: group-sharded pools under pjit/GSPMD)
+  whose per-contract "tp2" collective inventories are pinned —
+  all-reduce only for the forward steps, zero collectives for the
+  shard-local page copy;
 - `ops.weight_quant`, the one-shot fp->int8 decode-weight quantizer;
 - `train.step` on tp2 AND dp2x2 meshes — the two forecast mesh shapes
   whose collective inventories ROADMAP items 1/2/4 will be verified
@@ -276,6 +280,32 @@ def _audit_engine() -> List[TargetResult]:
         eng_t.recorder.record("audit_lower", contract=name)
         res.facts["telemetry"] = True
         results.append(res)
+    # tp2-mesh engine (ISSUE 14): the five entry points lowered on a
+    # (1,1,1,2) serving mesh against group-sharded pools — the
+    # collective inventory each contract declares for "tp2" is pinned
+    # here (all-reduce only for the forward steps, ZERO collectives
+    # for the shard-local page copy), alongside the same zero-host-
+    # callback / no-fp64 / temp-bytes checks as the single-chip and
+    # int8 rows. Lowering runs under the engine's mesh_scope: the
+    # GSPMD constraints bake at trace time, so what this audits is
+    # exactly the program tp traffic runs.
+    import jax as _jax
+
+    if len(_jax.devices()) >= 2:
+        eng_tp = DecodeEngine(
+            model, params, slots=2, page_size=16, max_context=64,
+            step_horizon=8, prefill_chunk_tokens=16, spec_decode_k=2,
+            vocab_size=256, serving_tp=2)
+        with eng_tp.mesh_scope():
+            for name, fn, args in eng_tp.audit_entry_points():
+                res = audit_lowered(name, "tp2", fn, args)
+                res.facts["serving_tp"] = 2
+                results.append(res)
+    else:
+        r = TargetResult(contract="engine.decode_scan", mesh_tag="tp2")
+        r.fail("tp2 engine audit needs >= 2 devices — provision "
+               "virtual CPU devices (utils/virtual_mesh.py)")
+        results.append(r)
     # the one-shot weight quantizer itself (fp decode tree -> weight-
     # only int8): a registered jitted entry point like any other
     fp_layers = model.prepare_decode_params(params)["layers"]

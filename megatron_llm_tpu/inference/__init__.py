@@ -16,3 +16,8 @@ from megatron_llm_tpu.inference.engine import (  # noqa: F401
 from megatron_llm_tpu.inference.prefix_cache import (  # noqa: F401
     PrefixCache,
 )
+from megatron_llm_tpu.inference.router import (  # noqa: F401
+    EngineReplica,
+    HTTPReplica,
+    ReplicaRouter,
+)

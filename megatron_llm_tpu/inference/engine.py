@@ -92,11 +92,25 @@ a dtype), and `quantize_weights=True` swaps the decode GEMV weights for
 one-shot weight-only int8. Both default OFF: the fp path keeps its
 bitwise generate_tokens parity; the int8 path's accuracy is a measured
 drift bound (bench `extra.quant`, docs/GUIDE.md "Quantized serving").
+
+ISSUE 14 grows the engine a mesh axis and a fleet: `serving_tp > 1`
+shards the page pools (and scale pools) over the head/group axis and
+runs every jitted step — decode scan, mixed step, spec verify, prefill
+buckets, COW page copy — under pjit on a tp mesh via GSPMD constraints
+(kv_pool_spec / decode_param_specs, parallel/sharding.py), with page
+tables, lengths and the per-slot sampling arrays replicated; the Pallas
+paged kernels already read per-(group) blocks, so each shard runs them
+over its own groups with the XLA twins as the CPU oracle. N such
+engines (each tagged `replica_id`, optionally pinned to a `devices`
+subset) sit behind the prefix-affinity router (inference/router.py),
+which dispatches shared-prefix traffic to the replica whose PrefixCache
+already holds the pages and falls back least-loaded.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import logging
 import queue as queue_mod
 import threading
@@ -225,6 +239,10 @@ class EngineRequest:
     rid: int
     prompt: List[int]
     tokens_to_generate: int
+    # which replica's engine owns this request (ISSUE 14): None on a
+    # standalone engine; the router routes cancel() by it and the SSE
+    # `id:` field carries it so N replicas' rids stay distinguishable
+    replica_id: Optional[int] = None
     greedy: bool = True
     top_k: int = 0
     top_p: float = 0.0
@@ -313,7 +331,16 @@ class _Slot:
 @compile_contract(
     "engine.decode_scan",
     max_variants=16,  # 2 specializations x (log2(horizon)+1) pow2 buckets
-    collectives={"single": frozenset()},
+    collectives={"single": frozenset(),
+                 # tp2 (ISSUE 14): all-reduce = the row-parallel wo/w2
+                 # partial sums and the vocab-sharded embedding/head/
+                 # argmax reductions; all-gather = the carried
+                 # last_logits re-replicating each scan step (the
+                 # carry is a REPLICATED per-slot operand by design —
+                 # the host reads tokens from it and sampling sorts
+                 # it whole). reduce-scatter would be a resharding
+                 # leak and fails the audit.
+                 "tp2": frozenset({"all-reduce", "all-gather"})},
     tmp_bytes_budget=1 << 20,
     notes="pow2-bucketed scan horizons x {greedy, mixed}; the engine "
           "passes the config-derived budget "
@@ -400,7 +427,8 @@ def _make_step_fn(model, vocab_size, horizon, all_greedy):
 @compile_contract(
     "engine.mixed_step",
     max_variants=24,  # 2 specializations x (log2(chunk budget)+1) widths
-    collectives={"single": frozenset()},
+    collectives={"single": frozenset(),
+                 "tp2": frozenset({"all-reduce"})},  # see decode_scan
     tmp_bytes_budget=4 << 20,
     notes="pow2 chunk-width buckets x {greedy, mixed}; the engine "
           "passes 2*len(mixed_width_buckets(prefill_chunk_tokens)) "
@@ -490,7 +518,8 @@ def _make_mixed_step_fn(model, vocab_size, width, all_greedy):
     "engine.prefill_bucket",
     max_variants=8,  # == DecodeEngine._PREFILL_CACHE_CAP: the LRU
     # eviction path release_variant()s, so the live count IS the cache
-    collectives={"single": frozenset()},
+    collectives={"single": frozenset(),
+                 "tp2": frozenset({"all-reduce"})},  # see decode_scan
     tmp_bytes_budget=8 << 20,
     notes="whole-prompt mode only; one executable per prefill bucket, "
           "LRU-bounded — eviction releases the variant")
@@ -552,7 +581,11 @@ def _make_prefill_fn(model, prefill_len, page_size):
 @compile_contract(
     "engine.spec_verify",
     max_variants=2,  # ONE width (spec_decode_k+1) x {greedy, mixed}
-    collectives={"single": frozenset()},
+    collectives={"single": frozenset(),
+                 # all-gather: the replicated last_logits carry +
+                 # per-position greedy targets the host books — see
+                 # decode_scan
+                 "tp2": frozenset({"all-reduce", "all-gather"})},
     tmp_bytes_budget=4 << 20,
     notes="all spec traffic verifies through width spec_decode_k+1; "
           "shorter drafts pad via chunk_lens — per-draft-length buckets "
@@ -642,7 +675,11 @@ def _make_spec_step_fn(model, vocab_size, width, all_greedy):
 @compile_contract(
     "engine.page_copy",
     max_variants=1,  # src/dst are traced scalars: ONE executable ever
-    collectives={"single": frozenset()},
+    collectives={"single": frozenset(),
+                 # tp2: copies are shard-local (the pages axis is
+                 # unsharded; each chip copies its own group slice) —
+                 # ZERO collectives, pinned
+                 "tp2": frozenset()},
     tmp_bytes_budget=1 << 20,
     notes="the prefix cache's COW copy; a second variant would mean "
           "src/dst leaked into the static signature")
@@ -725,6 +762,31 @@ class DecodeEngine:
       the decode GEMV weights (per-output-channel scales,
       prepare_decode_params(quantize_int8=True)); decode matvecs read
       half the weight bytes. Decode-only — the fp tree is untouched.
+    - `serving_tp` (default 1, ISSUE 14): tensor-parallel degree of
+      the serving mesh. The K/V page pools (and int8 scale pools)
+      shard over the head/group axis (parallel/sharding.kv_pool_spec
+      — the zero1_axis one-rule idiom), decode params shard by
+      decode_param_specs, and every jitted step runs under pjit on a
+      (1,1,1,tp) mesh via GSPMD constraints (shard_map's
+      partial-manual form cannot lower on this XLA build,
+      KNOWN_FAILURES.md). Page tables / lengths / per-slot sampling
+      arrays stay replicated host-trivial operands. Must divide
+      num_query_groups. Greedy TOKEN streams match the single-chip
+      engine bitwise; logprobs carry the same last-ulps latitude the
+      backend's matmul blocking already has across chunk widths (the
+      tp all-reduce reorders the row-parallel reduction) — pinned in
+      tests/test_tp_serving.py. Incompatible with quantize_weights
+      (flattened-GLU layout); docs/GUIDE.md "Serving on a tp mesh &
+      replica routing".
+    - `devices` (default None = jax.devices() prefix): pin the engine
+      to a device subset — N emulated replicas on one host each own a
+      device (inference/router.py, bench scaleout).
+    - `replica_id` (default None): tag this engine as replica i behind
+      a router: counters() grows `serve_replica_id`, flight-recorder
+      events and trace spans carry `replica`, and the SSE `id:` field
+      becomes "i-rid", so N replicas' aggregated metrics and dumps
+      stay distinguishable. None keeps every schema byte-compatible
+      with the standalone engine.
     - `trace_dir` (ISSUE 13): enable the host span tracer; the Chrome
       trace-event JSON exports here at stop(). `record_dir`: where the
       flight recorder dumps its crash artifact (defaults to trace_dir;
@@ -749,6 +811,9 @@ class DecodeEngine:
                  spec_decode_k: int = 0,
                  kv_dtype: str = "bf16",
                  quantize_weights: bool = False,
+                 serving_tp: int = 1,
+                 devices=None,
+                 replica_id: Optional[int] = None,
                  termination_id: Optional[int] = None,
                  vocab_size: Optional[int] = None, timers=None,
                  trace_dir: Optional[str] = None,
@@ -764,6 +829,48 @@ class DecodeEngine:
                 f"{kv_dtype!r}")
         self.model = model
         self.cfg = model.cfg
+        # -- tp mesh (ISSUE 14) -------------------------------------------
+        # serving_tp > 1: the pools shard over the head/group axis
+        # (kv_pool_spec, the zero1_axis one-rule idiom) and every
+        # jitted step runs under pjit on a (1, 1, 1, tp) mesh via GSPMD
+        # constraints — NOT shard_map, whose partial-manual form this
+        # XLA build cannot lower (KNOWN_FAILURES.md). `devices` pins
+        # the engine to a device subset even at tp=1 (N emulated
+        # replicas on one host each own a device — bench scaleout /
+        # inference/router.py). Page tables, lengths, and the per-slot
+        # sampling arrays stay REPLICATED: they are host-trivial
+        # scalar-prefetch operands every chip must agree on.
+        self.serving_tp = max(1, serving_tp)
+        self.replica_id = replica_id
+        if self.serving_tp > 1 or devices is not None:
+            from megatron_llm_tpu.parallel.mesh import (
+                ParallelContext,
+                build_mesh,
+            )
+
+            if self.cfg.num_query_groups % self.serving_tp != 0:
+                raise ValueError(
+                    f"serving_tp={self.serving_tp} must divide the KV "
+                    f"group count ({self.cfg.num_query_groups}): the "
+                    f"page pools shard over the group axis "
+                    f"(parallel/sharding.kv_pool_spec) — use a tp that "
+                    f"divides num_query_groups, or replicate the "
+                    f"engine behind the router instead (docs/GUIDE.md "
+                    f"'Serving on a tp mesh & replica routing')")
+            if quantize_weights and self.serving_tp > 1:
+                raise ValueError(
+                    "quantize_weights is single-chip-layout only (the "
+                    "weight-only int8 decode tree bakes the flattened "
+                    "(h, 2f) GLU view, whose gate|up concat crosses "
+                    "the tp shard boundary); serve the fp decode tree "
+                    "on a tp mesh, or quantize at tp=1 (docs/GUIDE.md "
+                    "'Serving on a tp mesh & replica routing')")
+            self._ctx = ParallelContext(
+                build_mesh(tp=self.serving_tp, devices=devices))
+            self._rep = self._ctx.sharding()  # replicated operands
+        else:
+            self._ctx = None
+            self._rep = None
         self.slots = slots
         self.page_size = page_size
         self.max_pages_per_slot = max_context // page_size
@@ -807,15 +914,32 @@ class DecodeEngine:
                     "prepare_decode_params(quantize_int8=...) decode "
                     "layout (weight-only int8 is a decode-tree "
                     "transform)")
-            self._dec_params = model.prepare_decode_params(
-                params, quantize_int8=True)
+            dec = model.prepare_decode_params(params, quantize_int8=True)
+        elif hasattr(model, "prepare_decode_params"):
+            # tp engines keep the UNFLATTENED (h, 2, f) GLU layout: the
+            # single-chip (h, 2f) flatten concatenates gate|up along
+            # the axis tp shards (parallel/sharding.decode_param_specs)
+            dec = model.prepare_decode_params(
+                params, flatten_glu=(self.serving_tp == 1))
         else:
-            self._dec_params = (model.prepare_decode_params(params)
-                                if hasattr(model, "prepare_decode_params")
-                                else params)
+            dec = params
+        if self._ctx is not None:
+            if self.serving_tp > 1:
+                from megatron_llm_tpu.parallel.sharding import (
+                    decode_param_shardings,
+                )
+
+                dec = jax.device_put(
+                    dec, decode_param_shardings(self._ctx, self.cfg, dec))
+            else:
+                # tp=1 on a pinned device (an emulated replica): the
+                # whole tree rides the one-device mesh, replicated
+                dec = jax.device_put(dec, self._rep)
+        self._dec_params = dec
         caches = model.init_paged_kv_caches(
             slots, self.num_pages, page_size, self.max_pages_per_slot,
-            kv_dtype=jnp.int8 if kv_dtype == "int8" else None)
+            kv_dtype=jnp.int8 if kv_dtype == "int8" else None,
+            mesh_ctx=self._ctx)
         self._pools_k = caches["k_pages_layers"]
         self._pools_v = caches["v_pages_layers"]
         # int8 engines (ISSUE 9): per-layer fp32 scale pools ride every
@@ -839,7 +963,7 @@ class DecodeEngine:
                 "page_size 32/64 (docs/GUIDE.md 'Quantized serving')",
                 page_size)
         V = self.cfg.padded_vocab_size
-        self._last_logits = jnp.zeros((slots, V), jnp.float32)
+        self._last_logits = self._dev(np.zeros((slots, V), np.float32))
         # host-authoritative mirrors (tiny; shipped to device each step)
         self._pt = np.zeros((slots, self.max_pages_per_slot), np.int32)
         self._lengths = np.zeros((slots,), np.int32)
@@ -910,7 +1034,16 @@ class DecodeEngine:
         self.record_dir = record_dir if record_dir is not None else trace_dir
         self.tracer: SpanTracer = (SpanTracer(enabled=True)
                                    if trace_dir else NULL_TRACER)
-        self.recorder = FlightRecorder(flight_recorder_size)
+        if replica_id is not None:
+            # replica correlation (ISSUE 14): every span and flight-
+            # recorder event from this engine names its replica, so
+            # aggregated dumps from N replicas behind the router stay
+            # attributable (the SSE `id:` field and counters() carry
+            # the same tag)
+            self.tracer.set_context(replica=replica_id)
+        self.recorder = FlightRecorder(
+            flight_recorder_size,
+            base=None if replica_id is None else {"replica": replica_id})
         self._hists = {
             "serve_ttft_ms": Histogram(
                 "serve_ttft_ms", help_text="submit -> first generated "
@@ -932,6 +1065,54 @@ class DecodeEngine:
         self._profile_active = False
         self._profile_left = 0
         self._profile_dir: Optional[str] = None
+
+    # -- tp-mesh plumbing (ISSUE 14) ---------------------------------------
+
+    def _dev(self, x, dtype=None):
+        """Host operand -> device array. Single-chip engines keep the
+        jnp.asarray fast path (bitwise-unchanged); mesh engines
+        device_put REPLICATED onto the serving mesh — a committed
+        single-device array mixed into a pjit over sharded pools would
+        be an incompatible-devices error, and every small operand
+        (page table, lengths, sampling knob arrays, scan inputs) is by
+        contract replicated (host-trivial scalar prefetch)."""
+        if dtype is not None:
+            x = np.asarray(x, dtype)
+        if self._ctx is None:
+            return jnp.asarray(x)
+        return jax.device_put(np.asarray(x), self._rep)
+
+    def _artifact_tag(self, base: str) -> str:
+        """Filename tag for exported artifacts (span traces, flight-
+        record dumps): N in-process replicas share a pid, so an
+        untagged per-pid filename would let later replicas silently
+        overwrite earlier ones' postmortems — the replica id joins the
+        name whenever one is set."""
+        if self.replica_id is None:
+            return base
+        return f"{base}-r{self.replica_id}"
+
+    def mesh_scope(self):
+        """Context manager installing the serving-mesh ParallelContext
+        for the duration of a dispatch: the model's shard_activation
+        constraints read the global context AT TRACE TIME, so every
+        site that can trace a step executable (step()/warmup()/
+        audit_entry_points()) runs under this scope. GSPMD then
+        partitions the traced program over the tp mesh — pools sharded
+        per kv_pool_spec, activations steered by the existing
+        heads/groups/ffn constraint sites, collectives materialised by
+        the partitioner (the pjit-TPUv4 playbook; shard_map is
+        unusable here, KNOWN_FAILURES.md). `use_mesh` installs a
+        THREAD-LOCAL override (parallel/mesh.py), so N tp engines'
+        serve threads each trace under their own mesh concurrently —
+        no process-wide lock, no fleet serialization. tp=1 engines
+        (including device-pinned replicas) return a null scope: a
+        1-device mesh needs no constraints at all."""
+        if self._ctx is None or self.serving_tp == 1:
+            return contextlib.nullcontext()
+        from megatron_llm_tpu.parallel.mesh import use_mesh
+
+        return use_mesh(self._ctx)
 
     # -- admission ---------------------------------------------------------
 
@@ -987,6 +1168,7 @@ class DecodeEngine:
         req = EngineRequest(
             rid=-1, prompt=list(prompt),
             tokens_to_generate=tokens_to_generate,
+            replica_id=self.replica_id,
             greedy=(top_k == 1), top_k=top_k, top_p=top_p,
             temperature=temperature, seed=seed,
             return_log_probs=return_log_probs,
@@ -1163,9 +1345,9 @@ class DecodeEngine:
                              self._pools_vs) = self._copy_fn(
                                 self._pools_k, self._pools_v,
                                 self._pools_ks, self._pools_vs,
-                                jnp.asarray(match.cow_src, jnp.int32),
-                                jnp.asarray(pages[match.full_pages],
-                                            jnp.int32))
+                                self._dev(match.cow_src, np.int32),
+                                self._dev(pages[match.full_pages],
+                                          np.int32))
                         self._prefix.release_page(match.cow_src)
                         self._prefix.cow_copies += 1
                 if self._prefix is not None:
@@ -1182,9 +1364,9 @@ class DecodeEngine:
                         self._prefill_fn(plen)(
                             self._dec_params, self._pools_k, self._pools_v,
                             self._pools_ks, self._pools_vs,
-                            jnp.asarray(np.asarray(req.prompt[:plen],
-                                                   np.int32)[None]),
-                            jnp.asarray(self._pt[si]),
+                            self._dev(np.asarray(req.prompt[:plen],
+                                                 np.int32)[None]),
+                            self._dev(self._pt[si]),
                         )
                 self._last_logits = \
                     self._last_logits.at[si].set(row_logits)
@@ -1360,7 +1542,12 @@ class DecodeEngine:
         telemetry-blind."""
         if self._profile_pending is not None:
             self._start_profile()
-        did = self._step_inner()
+        with self.mesh_scope():
+            # the serving-mesh context is read at TRACE time by the
+            # model's shard_activation sites; any round can lazily
+            # trace a new horizon/width bucket, so every dispatch runs
+            # scoped (a no-op null scope on tp=1 engines)
+            did = self._step_inner()
         if did:
             self._rounds += 1
             if self._rounds % 256 == 0:
@@ -1537,12 +1724,12 @@ class DecodeEngine:
             self._step_fn(hor, all_greedy)(
                 self._dec_params, self._pools_k, self._pools_v,
                 self._pools_ks, self._pools_vs,
-                jnp.asarray(self._pt), jnp.asarray(self._lengths),
-                self._last_logits, jnp.asarray(active),
-                jnp.asarray(forced), jnp.asarray(use_forced),
-                jnp.asarray(greedy), jnp.asarray(temperature),
-                jnp.asarray(top_k), jnp.asarray(top_p),
-                jnp.asarray(seeds), jnp.asarray(sample_steps),
+                self._dev(self._pt), self._dev(self._lengths),
+                self._last_logits, self._dev(active),
+                self._dev(forced), self._dev(use_forced),
+                self._dev(greedy), self._dev(temperature),
+                self._dev(top_k), self._dev(top_p),
+                self._dev(seeds), self._dev(sample_steps),
             )
         self._last_logits = new_logits
         chosen = np.asarray(chosen)  # (slots, hor) — the scheduler's
@@ -1639,13 +1826,13 @@ class DecodeEngine:
             self._mixed_fn(width, all_greedy)(
             self._dec_params, self._pools_k, self._pools_v,
             self._pools_ks, self._pools_vs,
-            jnp.asarray(self._pt), jnp.asarray(self._lengths),
-            self._last_logits, jnp.asarray(chunk_tokens),
-            jnp.asarray(chunk_lens), jnp.asarray(is_prefill),
-            jnp.asarray(ci, jnp.int32),
-            jnp.asarray(greedy), jnp.asarray(temperature),
-            jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(seeds), jnp.asarray(sample_steps),
+            self._dev(self._pt), self._dev(self._lengths),
+            self._last_logits, self._dev(chunk_tokens),
+            self._dev(chunk_lens), self._dev(is_prefill),
+            self._dev(ci, np.int32),
+            self._dev(greedy), self._dev(temperature),
+            self._dev(top_k), self._dev(top_p),
+            self._dev(seeds), self._dev(sample_steps),
         )
         self._last_logits = new_last
         first = np.asarray(first)
@@ -1834,12 +2021,12 @@ class DecodeEngine:
             self._spec_fn(width, all_greedy)(
             self._dec_params, self._pools_k, self._pools_v,
             self._pools_ks, self._pools_vs,
-            jnp.asarray(self._pt), jnp.asarray(self._lengths),
-            self._last_logits, jnp.asarray(chunk_tokens),
-            jnp.asarray(chunk_lens), jnp.asarray(is_spec),
-            jnp.asarray(greedy), jnp.asarray(temperature),
-            jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(seeds), jnp.asarray(sample_steps),
+            self._dev(self._pt), self._dev(self._lengths),
+            self._last_logits, self._dev(chunk_tokens),
+            self._dev(chunk_lens), self._dev(is_spec),
+            self._dev(greedy), self._dev(temperature),
+            self._dev(top_k), self._dev(top_p),
+            self._dev(seeds), self._dev(sample_steps),
         )
         self._last_logits = new_last
         first = np.asarray(first)
@@ -1959,25 +2146,29 @@ class DecodeEngine:
         rows), lengths are untouched on the host, and the returned
         last_logits is discarded, so warmup is invisible to traffic.
         Opt-in: `warmup_compile=True` runs it inside `start()`."""
+        with self.mesh_scope():
+            self._warmup_scoped()
+
+    def _warmup_scoped(self):
         n = self.slots
-        zeros_i = np.zeros((n,), np.int32)
-        null_pt = jnp.asarray(np.zeros_like(self._pt))
+        zeros_i = self._dev(np.zeros((n,), np.int32))
+        null_pt = self._dev(np.zeros_like(self._pt))
         for h in horizon_buckets(self.step_horizon):
             (_, _, _, self._pools_k, self._pools_v, self._pools_ks,
              self._pools_vs) = self._step_fn(
                 h, True)(
                 self._dec_params, self._pools_k, self._pools_v,
                 self._pools_ks, self._pools_vs,
-                null_pt, jnp.asarray(zeros_i), self._last_logits,
-                jnp.asarray(np.zeros(n, bool)),
-                jnp.asarray(np.zeros((n, h), np.int32)),
-                jnp.asarray(np.zeros((n, h), bool)),
-                jnp.asarray(np.ones(n, bool)),
-                jnp.asarray(np.ones(n, np.float32)),
-                jnp.asarray(zeros_i),
-                jnp.asarray(np.zeros(n, np.float32)),
-                jnp.asarray(np.zeros(n, np.uint32)),
-                jnp.asarray(zeros_i),
+                null_pt, zeros_i, self._last_logits,
+                self._dev(np.zeros(n, bool)),
+                self._dev(np.zeros((n, h), np.int32)),
+                self._dev(np.zeros((n, h), bool)),
+                self._dev(np.ones(n, bool)),
+                self._dev(np.ones(n, np.float32)),
+                zeros_i,
+                self._dev(np.zeros(n, np.float32)),
+                self._dev(np.zeros(n, np.uint32)),
+                zeros_i,
             )
         if self.prefill_chunk_tokens:
             for w in mixed_width_buckets(self.prefill_chunk_tokens):
@@ -1986,17 +2177,17 @@ class DecodeEngine:
                     self._mixed_fn(w, True)(
                     self._dec_params, self._pools_k, self._pools_v,
                     self._pools_ks, self._pools_vs,
-                    null_pt, jnp.asarray(zeros_i), self._last_logits,
-                    jnp.asarray(np.zeros((n, w), np.int32)),
-                    jnp.asarray(zeros_i),
-                    jnp.asarray(np.zeros(n, bool)),
-                    jnp.asarray(0, jnp.int32),
-                    jnp.asarray(np.ones(n, bool)),
-                    jnp.asarray(np.ones(n, np.float32)),
-                    jnp.asarray(zeros_i),
-                    jnp.asarray(np.zeros(n, np.float32)),
-                    jnp.asarray(np.zeros(n, np.uint32)),
-                    jnp.asarray(zeros_i),
+                    null_pt, zeros_i, self._last_logits,
+                    self._dev(np.zeros((n, w), np.int32)),
+                    zeros_i,
+                    self._dev(np.zeros(n, bool)),
+                    self._dev(0, np.int32),
+                    self._dev(np.ones(n, bool)),
+                    self._dev(np.ones(n, np.float32)),
+                    zeros_i,
+                    self._dev(np.zeros(n, np.float32)),
+                    self._dev(np.zeros(n, np.uint32)),
+                    zeros_i,
                 )
         if self.spec_decode_k:
             w = self.spec_decode_k + 1
@@ -2005,16 +2196,16 @@ class DecodeEngine:
                 self._spec_fn(w, True)(
                 self._dec_params, self._pools_k, self._pools_v,
                 self._pools_ks, self._pools_vs,
-                null_pt, jnp.asarray(zeros_i), self._last_logits,
-                jnp.asarray(np.zeros((n, w), np.int32)),
-                jnp.asarray(zeros_i),
-                jnp.asarray(np.zeros(n, bool)),
-                jnp.asarray(np.ones(n, bool)),
-                jnp.asarray(np.ones(n, np.float32)),
-                jnp.asarray(zeros_i),
-                jnp.asarray(np.zeros(n, np.float32)),
-                jnp.asarray(np.zeros(n, np.uint32)),
-                jnp.asarray(zeros_i),
+                null_pt, zeros_i, self._last_logits,
+                self._dev(np.zeros((n, w), np.int32)),
+                zeros_i,
+                self._dev(np.zeros(n, bool)),
+                self._dev(np.ones(n, bool)),
+                self._dev(np.ones(n, np.float32)),
+                zeros_i,
+                self._dev(np.zeros(n, np.float32)),
+                self._dev(np.zeros(n, np.uint32)),
+                zeros_i,
             )
 
     def audit_entry_points(self):
@@ -2025,23 +2216,27 @@ class DecodeEngine:
         what traffic runs. Args mirror warmup()'s idle-round
         construction (null page table, zero lengths); nothing here
         executes — builders are invoked (minting variants within the
-        engine's own budgets) but the returned fns are only lowered."""
+        engine's own budgets) but the returned fns are only lowered.
+
+        On a tp mesh the caller must ALSO lower under `mesh_scope()`
+        (analysis/audit.py does): the constraints bake at trace time,
+        and the tp2 audit rows exist to pin exactly that program."""
         n = self.slots
-        zeros_i = jnp.asarray(np.zeros((n,), np.int32))
-        null_pt = jnp.asarray(np.zeros_like(self._pt))
-        zeros_b = jnp.asarray(np.zeros(n, bool))
-        ones_b = jnp.asarray(np.ones(n, bool))
-        ones_f = jnp.asarray(np.ones(n, np.float32))
-        zeros_f = jnp.asarray(np.zeros(n, np.float32))
-        zeros_u = jnp.asarray(np.zeros(n, np.uint32))
+        zeros_i = self._dev(np.zeros((n,), np.int32))
+        null_pt = self._dev(np.zeros_like(self._pt))
+        zeros_b = self._dev(np.zeros(n, bool))
+        ones_b = self._dev(np.ones(n, bool))
+        ones_f = self._dev(np.ones(n, np.float32))
+        zeros_f = self._dev(np.zeros(n, np.float32))
+        zeros_u = self._dev(np.zeros(n, np.uint32))
         h = horizon_buckets(self.step_horizon)[-1]
         out = [(
             "engine.decode_scan", self._step_fn(h, True),
             (self._dec_params, self._pools_k, self._pools_v,
              self._pools_ks, self._pools_vs, null_pt,
              zeros_i, self._last_logits, zeros_b,
-             jnp.asarray(np.zeros((n, h), np.int32)),
-             jnp.asarray(np.zeros((n, h), bool)), ones_b, ones_f,
+             self._dev(np.zeros((n, h), np.int32)),
+             self._dev(np.zeros((n, h), bool)), ones_b, ones_f,
              zeros_i, zeros_f, zeros_u, zeros_i))]
         if self.prefill_chunk_tokens:
             w = mixed_width_buckets(self.prefill_chunk_tokens)[-1]
@@ -2050,16 +2245,16 @@ class DecodeEngine:
                 (self._dec_params, self._pools_k, self._pools_v,
                  self._pools_ks, self._pools_vs, null_pt,
                  zeros_i, self._last_logits,
-                 jnp.asarray(np.zeros((n, w), np.int32)), zeros_i,
-                 zeros_b, jnp.asarray(0, jnp.int32), ones_b, ones_f,
+                 self._dev(np.zeros((n, w), np.int32)), zeros_i,
+                 zeros_b, self._dev(0, np.int32), ones_b, ones_f,
                  zeros_i, zeros_f, zeros_u, zeros_i)))
         plen = bucket_prefill_len(min(8, self.max_context))
         out.append((
             "engine.prefill_bucket", self._prefill_fn(plen),
             (self._dec_params, self._pools_k, self._pools_v,
              self._pools_ks, self._pools_vs,
-             jnp.asarray(np.zeros((1, plen), np.int32)),
-             jnp.asarray(self._pt[0]))))
+             self._dev(np.zeros((1, plen), np.int32)),
+             self._dev(self._pt[0]))))
         if self.spec_decode_k:
             w = self.spec_decode_k + 1
             out.append((
@@ -2067,14 +2262,14 @@ class DecodeEngine:
                 (self._dec_params, self._pools_k, self._pools_v,
                  self._pools_ks, self._pools_vs, null_pt,
                  zeros_i, self._last_logits,
-                 jnp.asarray(np.zeros((n, w), np.int32)), zeros_i,
+                 self._dev(np.zeros((n, w), np.int32)), zeros_i,
                  zeros_b, ones_b, ones_f, zeros_i, zeros_f, zeros_u,
                  zeros_i)))
         out.append((
             "engine.page_copy", self._copy_fn,
             (self._pools_k, self._pools_v, self._pools_ks,
-             self._pools_vs, jnp.asarray(0, jnp.int32),
-             jnp.asarray(0, jnp.int32))))
+             self._pools_vs, self._dev(0, np.int32),
+             self._dev(0, np.int32))))
         return out
 
     def start(self):
@@ -2082,11 +2277,19 @@ class DecodeEngine:
         # startup capacity log (ISSUE 9): the kv_dtype decision and
         # what it buys, in the operator's units — mirrors the
         # serve_kv_* gauges on GET /metrics
+        # capacity numbers are PER CHIP from live shardings (ISSUE 14
+        # small fix): on a tp mesh the group-sharded pools cost 1/tp
+        # per chip, and this log is what operators size against HBM
         _logger.info(
-            "decode engine: %d slots, paged KV pool kv_dtype=%s — "
-            "%d pages x %d tokens = %d KV positions, %.1f MiB pool "
-            "(%d bytes/token)%s%s",
-            self.slots, self.kv_pool_dtype(), self.num_pages - 1,
+            "decode engine%s: %d slots, paged KV pool kv_dtype=%s%s — "
+            "%d pages x %d tokens = %d KV positions, %.1f MiB/chip "
+            "pool (%d bytes/token/chip)%s%s",
+            "" if self.replica_id is None
+            else f" [replica {self.replica_id}]",
+            self.slots, self.kv_pool_dtype(),
+            "" if self.serving_tp == 1
+            else f" tp={self.serving_tp} (group-sharded)",
+            self.num_pages - 1,
             self.page_size, (self.num_pages - 1) * self.page_size,
             self.kv_pool_bytes() / 2**20, self.kv_bytes_per_token(),
             ", weight-only int8 decode matmuls"
@@ -2120,7 +2323,8 @@ class DecodeEngine:
                         live_rids=[s.req.rid for s in self._slots
                                    if s.req is not None])
                     self.recorder.note_counters(self.counters())
-                    self.recorder.dump(self.record_dir, "engine-poison")
+                    self.recorder.dump(self.record_dir,
+                                       self._artifact_tag("engine-poison"))
                     self._stop_profile()
                     self._fail_all(self._broken)
                     self._running = False
@@ -2157,7 +2361,9 @@ class DecodeEngine:
             import os as _os
 
             path = self.tracer.export(_os.path.join(
-                self.trace_dir, f"trace_engine_{_os.getpid()}.json"))
+                self.trace_dir,
+                f"trace_{self._artifact_tag('engine')}_"
+                f"{_os.getpid()}.json"))
             if path:
                 _logger.info("engine span trace exported to %s "
                              "(Perfetto / chrome://tracing)", path)
@@ -2175,19 +2381,28 @@ class DecodeEngine:
         return str(self._pools_k[0].dtype)
 
     def kv_pool_bytes(self) -> int:
-        """Total HBM the paged KV pool holds — data pools plus (int8)
-        scale pools, summed over layers. Derived from the ACTUAL
-        allocated arrays, so the capacity gauges can never drift from
-        what the engine really pays."""
-        leaves = (*self._pools_k, *self._pools_v,
-                  *self._pools_ks, *self._pools_vs)
-        return int(sum(x.size * x.dtype.itemsize for x in leaves))
+        """PER-CHIP HBM the paged KV pool holds — data pools plus
+        (int8) scale pools, summed over layers, derived from the LIVE
+        shardings of the actual allocated arrays (each leaf counts its
+        shard shape, not its global shape). On a single chip the two
+        are the same number this gauge always reported; on a tp mesh
+        the group-sharded pools cost 1/tp per chip, and reporting the
+        global bytes here would overstate per-chip capacity by tp×
+        (ISSUE 14 small fix — operators size THIS against one chip's
+        HBM). Pinned by tests/test_tp_serving.py."""
+        total = 0
+        for x in (*self._pools_k, *self._pools_v,
+                  *self._pools_ks, *self._pools_vs):
+            shard = x.sharding.shard_shape(x.shape)
+            total += int(np.prod(shard)) * x.dtype.itemsize
+        return total
 
     def kv_bytes_per_token(self) -> int:
-        """KV bytes one cached token costs across all layers (K + V
-        data + any scales) — the page-pool sizing number operators
-        compare against HBM (docs/GUIDE.md sizing math: ~96 KiB/token
-        bf16, ~48 KiB/token int8 on the bench model)."""
+        """PER-CHIP KV bytes one cached token costs across all layers
+        (K + V data + any scales) — the page-pool sizing number
+        operators compare against one chip's HBM (docs/GUIDE.md sizing
+        math: ~96 KiB/token bf16 at tp=1, /tp on a serving mesh, ~half
+        for int8)."""
         return round(self.kv_pool_bytes()
                      / (self.num_pages * self.page_size))
 
@@ -2230,7 +2445,14 @@ class DecodeEngine:
             # must never die mid-traffic
             ttft = list(self._ttft_ms)
             decode_ms = list(self._decode_ms)
-        out = {
+        out = {}
+        if self.replica_id is not None:
+            # replica tag first (ISSUE 14): aggregated /metrics from N
+            # replicas stay attributable at the router. ABSENT on
+            # standalone engines, so the pre-router JSON schema stays
+            # byte-compatible (tests/test_telemetry.py pins it).
+            out["serve_replica_id"] = self.replica_id
+        out |= {
             # capacity gauges (ISSUE 9): which dtype the pool ACTUALLY
             # stores (kv_pool_dtype — consistent with the bytes gauges
             # by construction), what it costs, and what one token

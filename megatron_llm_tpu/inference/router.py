@@ -1,0 +1,713 @@
+"""Prefix-affinity replica router: one front end over N decode-engine
+replicas (ISSUE 14).
+
+A single engine — even tp-sharded — caps out at one mesh's throughput;
+the next scaling axis is N independent replicas behind a dispatcher.
+The interesting routing decision is CACHE-AWARE: production traffic is
+dominated by shared system prompts (the `extra.serving.prefix` bench
+mix), and each replica's `PrefixCache` (inference/prefix_cache.py)
+holds the shared pages of exactly the prompts IT has served. Random or
+round-robin dispatch scatters a shared prefix across every replica —
+each one pays the full prefill once and caches a private copy; routing
+by prefix affinity sends a prompt to the replica that already holds its
+longest page-aligned prefix, so the fleet prefills each shared prefix
+roughly once and TTFT on shared traffic collapses toward the cache-hit
+floor (bench `extra.serving.scaleout` measures affinity-vs-random p95
+TTFT on the 80%-shared mix).
+
+Design (each rule is load-bearing):
+
+- **The router's index is ADVISORY, never authoritative.** It is a
+  page-aligned prefix -> replica map maintained router-side from the
+  router's own dispatch history (full pages only, capped at
+  len(prompt) - 1 — exactly the prefixes a replica's PrefixCache can
+  register). The replica's cache may have evicted an entry under pool
+  pressure, a hash chain may collide, a replica may have restarted: a
+  stale or wrong hit only routes a request to a colder replica that
+  re-prefills — a perf miss, never a correctness hazard. That is what
+  licenses the O(len(prompt)) rolling-hash walk instead of storing
+  token tuples.
+- **Health feeds routing, not the other way round.** Liveness comes
+  from the replica's existing `/health` surface (`DecodeEngine.health`
+  in process, GET /health over the wire): a poisoned serve loop
+  (`broken`) or dead thread takes the replica out of rotation, its
+  index entries drop (the pages died with its pools), and a cooldown
+  re-probe brings a recovered replica back cold. A submit-time failure
+  (engine stopped/poisoned mid-dispatch) marks the replica down and
+  FAILS OVER to the next candidate in policy order; `QueueFull` on one
+  replica tries the others before surfacing (the fleet is full only
+  when every queue is).
+- **Fallback is least-queue-depth.** On an affinity miss (or with
+  `affinity=False`) the request goes to the healthy replica with the
+  smallest queue_depth + slots_busy — the same load signal `/metrics`
+  exports. `fallback="random"` (seeded) exists as the control arm the
+  scaleout bench compares affinity against.
+- **Drain on stop.** `stop(drain=True)` drains every replica's queue
+  and slots before returning — the server's graceful-shutdown contract,
+  fleet-wide.
+
+The router deliberately duck-types the slice of the `DecodeEngine`
+surface the HTTP layer uses (`submit`/`cancel`/`counters`/`health`/
+`prometheus_metrics`/`flight_record`/`start`/`stop` + the
+max_context/page_size/num_pages admission limits), so
+`MegatronServer(engine=router)` serves a fleet through the same
+handler code that serves one engine. Aggregation rules: additive
+counters sum (`serve_kv_pool_bytes_fleet` scales each replica's
+per-chip gauge by its tp), latency histograms merge by bucket (they
+are cumulative by design — telemetry/prometheus.Histogram.merged;
+IN-PROCESS replicas only — remote replicas' bucket data is not on the
+JSON probe surface, scrape them directly), per-replica detail rides
+under `"replicas"`, and `router_*` counters expose the dispatch
+decisions themselves.
+
+`EngineReplica` wraps an in-process engine (tests, bench emulation,
+the `--router_replicas` serving tool); `HTTPReplica` speaks the same
+protocol to a remote replica over its existing HTTP surface for
+cross-host fleets (prompt keys are the request's token ids there too —
+the router sits behind tokenization).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["EngineReplica", "FleetUnavailable", "HTTPReplica",
+           "PrefixAffinityIndex", "ReplicaRouter"]
+
+
+def _queue_full_base():
+    from megatron_llm_tpu.inference.engine import QueueFull
+
+    return QueueFull
+
+
+class FleetUnavailable(_queue_full_base()):
+    """Every replica is poisoned/stopped/cooling down. Subclasses the
+    engine's QueueFull ON PURPOSE: both mean "the fleet cannot take
+    this request right now, retry later", and the HTTP layer already
+    maps QueueFull to 503 + Retry-After — a bare RuntimeError would
+    surface as a 500, which load balancers treat as a hard server
+    fault and eject, exactly when the fleet is one cooldown away from
+    recovering (GET /health reports the same transient state)."""
+
+
+class PrefixAffinityIndex:
+    """Router-side page-aligned prefix -> replica map.
+
+    Keys are a rolling hash chain over full prompt pages (key_d =
+    hash((key_{d-1}, page_d's tokens))), so indexing and lookup walk a
+    prompt ONCE — O(len(prompt)) — instead of hashing every
+    page-aligned prefix tuple separately (O(P^2) tokens for a P-page
+    prompt; the router sits on the submit path of every request).
+    Hash collisions can alias two prefixes: acceptable by the advisory
+    contract (a mis-route costs one cold prefill, never correctness).
+    LRU-bounded: entries past `cap_entries` evict oldest-touched, the
+    same pressure story as the replica-side cache it mirrors."""
+
+    def __init__(self, page_size: int, cap_entries: int = 8192):
+        assert page_size >= 1 and cap_entries >= 1
+        self.page_size = page_size
+        self.cap_entries = cap_entries
+        # key -> replica id; OrderedDict move_to_end is the LRU touch
+        self._map: "collections.OrderedDict[int, int]" = \
+            collections.OrderedDict()
+
+    def _keys(self, prompt: Sequence[int]):
+        """The hash-chain keys of every full-page prefix of `prompt`,
+        capped at len - 1 (mirroring PrefixCache: the last prompt token
+        always forwards for its logits, so no replica can ever have
+        cached through it)."""
+        ps = self.page_size
+        usable = (len(prompt) - 1) // ps
+        key = 0x9E3779B9  # chain seed, any fixed value
+        out = []
+        for d in range(usable):
+            key = hash((key, tuple(prompt[d * ps:(d + 1) * ps])))
+            out.append(key)
+        return out
+
+    def lookup(self, prompt: Sequence[int]) -> Tuple[Optional[int], int]:
+        """(replica holding the longest indexed prefix, pages matched);
+        (None, 0) on a miss. Touches the winning entry's LRU stamp."""
+        keys = self._keys(prompt)
+        best, depth = None, 0
+        for d, key in enumerate(keys, start=1):
+            r = self._map.get(key)
+            if r is None:
+                break
+            best, depth = r, d
+        if best is not None:
+            # re-touch the deepest hit only: it pins the chain
+            self._map.move_to_end(keys[depth - 1])
+        return best, depth
+
+    def register(self, prompt: Sequence[int], replica: int) -> None:
+        """Point every full-page prefix of `prompt` at `replica` — the
+        replica's own PrefixCache will register the same pages as its
+        prefill passes each boundary. Last writer wins (the newest
+        holder is the warmest)."""
+        for key in self._keys(prompt):
+            self._map[key] = replica
+            self._map.move_to_end(key)
+        while len(self._map) > self.cap_entries:
+            self._map.popitem(last=False)
+
+    def drop_replica(self, replica: int) -> int:
+        """Remove every entry pointing at `replica` (its pools — and
+        with them every cached page — died with its serve loop).
+        Returns the count dropped."""
+        dead = [k for k, r in self._map.items() if r == replica]
+        for k in dead:
+            del self._map[k]
+        return len(dead)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+class EngineReplica:
+    """In-process replica: a `DecodeEngine` (tagged with a replica_id)
+    behind the replica protocol the router speaks. The serving tool's
+    `--router_replicas`, the scaleout bench, and the router tests all
+    use this form; cross-host fleets use HTTPReplica."""
+
+    def __init__(self, engine):
+        if engine.replica_id is None:
+            raise ValueError(
+                "a routed engine needs a replica_id (DecodeEngine("
+                "replica_id=i)): the router routes cancel() by it and "
+                "every metric/dump from the fleet must stay "
+                "attributable")
+        self.engine = engine
+        self.replica_id = engine.replica_id
+
+    # -- dispatch ----------------------------------------------------------
+
+    def submit(self, prompt, tokens_to_generate, **kw):
+        return self.engine.submit(prompt, tokens_to_generate, **kw)
+
+    def cancel(self, req):
+        self.engine.cancel(req)
+
+    # -- health / load (the /health + /metrics feed) -----------------------
+
+    def health(self) -> dict:
+        return self.engine.health()
+
+    def load(self) -> int:
+        h = self.engine.health()
+        return h["queue_depth"] + h["slots_busy"]
+
+    def counters(self) -> dict:
+        return self.engine.counters()
+
+    def fleet_kv_pool_bytes(self) -> int:
+        """This replica's TOTAL pool HBM across its mesh: the per-chip
+        gauge (the ISSUE 14 small-fix semantics) times serving_tp —
+        what the router's fleet aggregate sums (summing per-chip
+        numbers across tp>1 replicas would be neither per-chip nor
+        fleet)."""
+        return self.engine.kv_pool_bytes() * self.engine.serving_tp
+
+    def histograms(self):
+        return self.engine.histograms()
+
+    def flight_record(self) -> dict:
+        return self.engine.flight_record()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self.engine._thread is None:
+            self.engine.start()
+
+    def stop(self, drain: bool = True):
+        self.engine.stop(drain=drain)
+
+    def drain(self):
+        """Wait until the replica is idle: with the serve loop running,
+        poll; otherwise step it here (manual-stepping tests/bench)."""
+        eng = self.engine
+        if eng._thread is not None and eng._thread.is_alive():
+            while True:
+                h = eng.health()
+                if not h["alive"] or (h["queue_depth"] == 0
+                                      and h["slots_busy"] == 0):
+                    return
+                time.sleep(0.002)
+        eng.drain()
+
+    @property
+    def max_context(self) -> int:
+        return self.engine.max_context
+
+    @property
+    def page_size(self) -> int:
+        return self.engine.page_size
+
+    @property
+    def num_pages(self) -> int:
+        return self.engine.num_pages
+
+
+class HTTPReplica:
+    """Remote replica over the engine server's existing HTTP surface
+    (GET /health, GET /metrics, PUT /api). Generation submits ride a
+    background thread per request so the router's submit stays
+    non-blocking like the in-process form; the returned handle exposes
+    the same `result(timeout)` contract as EngineRequest. Token
+    streaming, cancel, and latency histograms are not proxied — front
+    a remote fleet's streaming traffic at the replica, scrape each
+    replica's own /metrics for its distributions, or run the router
+    in-process with the engines (EngineReplica)."""
+
+    def __init__(self, replica_id: int, base_url: str,
+                 tokenizer=None, timeout_s: float = 600.0,
+                 probe_ttl_s: float = 1.0,
+                 page_size: int = 64, max_context: int = 2048):
+        self.replica_id = replica_id
+        self.base_url = base_url.rstrip("/")
+        self.tokenizer = tokenizer
+        self.timeout_s = timeout_s
+        self.probe_ttl_s = probe_ttl_s
+        self.page_size = page_size
+        self.max_context = max_context
+        self.num_pages = (max_context * 64) // page_size  # advisory
+        self._probe: Tuple[float, dict] = (0.0, {})
+
+    def _get_json(self, path: str) -> dict:
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(self.base_url + path,
+                                    timeout=5.0) as resp:
+            return json.loads(resp.read().decode())
+
+    def _probed(self) -> dict:
+        now = time.monotonic()
+        t, snap = self._probe
+        if now - t < self.probe_ttl_s:
+            return snap
+        try:
+            h = self._get_json("/health")
+            m = self._get_json("/metrics")
+            snap = {"health": h, "metrics": m}
+        except Exception as e:  # noqa: BLE001 — a dead probe IS the signal
+            snap = {"health": {"status": "unhealthy",
+                               "engine": {"alive": False,
+                                          "broken": repr(e),
+                                          "queue_depth": 0,
+                                          "slots_busy": 0}},
+                    "metrics": {}}
+        self._probe = (now, snap)
+        return snap
+
+    def health(self) -> dict:
+        h = self._probed()["health"]
+        eng = h.get("engine") or {}
+        return {"alive": h.get("status") == "ok"
+                and bool(eng.get("alive", True)),
+                "broken": eng.get("broken"),
+                "queue_depth": eng.get("queue_depth", 0),
+                "slots_busy": eng.get("slots_busy", 0)}
+
+    def load(self) -> int:
+        h = self.health()
+        return h["queue_depth"] + h["slots_busy"]
+
+    def counters(self) -> dict:
+        return dict(self._probed()["metrics"])
+
+    def fleet_kv_pool_bytes(self) -> int:
+        """The remote per-chip gauge as-is: a remote replica's tp is
+        not visible over /metrics JSON, so a tp>1 REMOTE replica's
+        contribution to the fleet sum undercounts by its tp — scrape
+        the replica directly for exact sizing (its own counters are
+        per-chip by contract)."""
+        return int(self.counters().get("serve_kv_pool_bytes", 0))
+
+    def histograms(self):
+        # NOT proxied: the JSON /metrics surface carries no bucket
+        # data, so the router's MERGED latency distributions cover
+        # in-process replicas only — scrape each remote replica's own
+        # /metrics (Prometheus form) for its histograms
+        return []
+
+    def flight_record(self) -> dict:
+        try:
+            return self._get_json("/flight_record")
+        except Exception as e:  # noqa: BLE001
+            return {"error": repr(e)}
+
+    def submit(self, prompt, tokens_to_generate, **kw):
+        import json
+        import urllib.request
+
+        if self.tokenizer is None:
+            raise ValueError(
+                "HTTPReplica.submit needs a tokenizer to detokenize "
+                "the prompt ids for PUT /api")
+        payload = {
+            "prompts": [self.tokenizer.detokenize(list(prompt))],
+            "tokens_to_generate": int(tokens_to_generate),
+            "top_k": int(kw.get("top_k", 1)),
+            "top_p": float(kw.get("top_p", 0.0)),
+            "temperature": float(kw.get("temperature", 1.0)),
+        }
+        if kw.get("seed", None) is not None:
+            payload["random_seed"] = int(kw["seed"])
+
+        handle = _HTTPResult(self.replica_id)
+
+        def run():
+            try:
+                req = urllib.request.Request(
+                    self.base_url + "/api",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="PUT")
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    handle._payload = json.loads(resp.read().decode())
+            except Exception as e:  # noqa: BLE001 — surfaced at result()
+                handle.error = repr(e)
+            handle.done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        return handle
+
+    def cancel(self, req):
+        _logger.warning("HTTPReplica cannot cancel a remote request")
+
+    def start(self):
+        pass
+
+    def stop(self, drain: bool = True):
+        pass
+
+    def drain(self):
+        while self.load() > 0:
+            time.sleep(0.05)
+
+
+class _HTTPResult:
+    """EngineRequest-shaped handle for one HTTPReplica submit."""
+
+    def __init__(self, replica_id: int):
+        self.replica_id = replica_id
+        self.rid = -1
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self._payload: Optional[dict] = None
+
+    def result(self, timeout: Optional[float] = None):
+        if not self.done.wait(timeout):
+            raise TimeoutError("remote request still running")
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return self._payload, None
+
+
+class ReplicaRouter:
+    """Prefix-affinity dispatcher over N replicas (module docstring).
+
+    Knobs (docs/GUIDE.md "Serving on a tp mesh & replica routing"):
+    - `affinity` (default True): route by the page-aligned prefix ->
+      replica index; off, every dispatch takes the fallback policy
+      (the scaleout bench's control arm).
+    - `fallback` ("least_loaded" | "random"): the policy on an
+      affinity miss / affinity off. Least-loaded reads
+      queue_depth + slots_busy from the replica's health surface.
+    - `index_entries`: LRU bound of the affinity index.
+    - `unhealthy_cooldown_s`: how long a replica marked down at
+      submit time stays out of rotation before the next health
+      re-probe may readmit it.
+    """
+
+    def __init__(self, replicas: List, *, affinity: bool = True,
+                 fallback: str = "least_loaded",
+                 index_entries: int = 8192,
+                 unhealthy_cooldown_s: float = 1.0,
+                 rng_seed: int = 0):
+        if not replicas:
+            raise ValueError("a router needs at least one replica")
+        if fallback not in ("least_loaded", "random"):
+            raise ValueError(f"unknown fallback policy {fallback!r}")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        sizes = {r.page_size for r in replicas}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"replicas disagree on page_size ({sorted(sizes)}): "
+                f"the affinity index is page-aligned and needs ONE "
+                f"granularity")
+        self.replicas = list(replicas)
+        self._by_id: Dict[int, object] = {r.replica_id: r
+                                          for r in replicas}
+        self.affinity = affinity
+        self.fallback = fallback
+        self.page_size = sizes.pop()
+        self.max_context = min(r.max_context for r in replicas)
+        self.num_pages = min(r.num_pages for r in replicas)
+        self._index = PrefixAffinityIndex(self.page_size, index_entries)
+        self._rng = random.Random(rng_seed)
+        self.unhealthy_cooldown_s = unhealthy_cooldown_s
+        self._down_until: Dict[int, float] = {}  # replica_id -> monotonic
+        self._lock = threading.Lock()  # index + policy state (submit
+        # can be called from N HTTP handler threads concurrently)
+        self._thread = None  # duck-typed "started" flag (server.run)
+
+        # dispatch accounting (served under counters()["router"])
+        self._dispatches = 0
+        self._affinity_hits = 0
+        self._affinity_hit_pages = 0
+        self._failovers = 0
+        self._rejected = 0
+        self._per_replica: Dict[int, int] = {r.replica_id: 0
+                                             for r in replicas}
+
+    # -- health ------------------------------------------------------------
+
+    def _probe(self) -> Tuple[List[int], Dict[int, int]]:
+        """(healthy replica ids, their load snapshot). Runs OUTSIDE
+        the router lock on purpose: for HTTPReplica fleets health/load
+        are network probes (seconds of blocking I/O on a sick host),
+        and one hung replica must never stall every other handler
+        thread's submit behind the lock. `_down_until` reads here are
+        unsynchronized — a stale read only delays rotation changes by
+        one dispatch, which the advisory contract absorbs."""
+        now = time.monotonic()
+        healthy: List[int] = []
+        loads: Dict[int, int] = {}
+        for rep in self.replicas:
+            rid = rep.replica_id
+            if self._down_until.get(rid, 0.0) > now:
+                continue
+            h = rep.health()
+            if h["alive"] and h["broken"] is None:
+                healthy.append(rid)
+                loads[rid] = h["queue_depth"] + h["slots_busy"]
+            else:
+                self._mark_down(rid, h["broken"] or "serve loop dead")
+        return healthy, loads
+
+    def _mark_down(self, rid: int, why) -> None:
+        """Takes the router lock itself — callers must NOT hold it."""
+        with self._lock:
+            dropped = self._index.drop_replica(rid)
+            self._down_until[rid] = (time.monotonic()
+                                     + self.unhealthy_cooldown_s)
+        _logger.warning(
+            "router: replica %d out of rotation (%s); %d affinity "
+            "entries dropped (its pools died with it), re-probe in "
+            "%.1fs", rid, why, dropped, self.unhealthy_cooldown_s)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _pick(self, prompt, healthy: List[int],
+              loads: Dict[int, int]) -> List[int]:
+        """Candidate replica ids in dispatch order: affinity hit first
+        (when it is healthy), then the fallback-policy ordering of the
+        rest — the failover path walks this list. Called under the
+        router lock (index + counters); load comes pre-probed."""
+        order: List[int] = []
+        if self.affinity:
+            hit, pages = self._index.lookup(prompt)
+            if hit is not None and hit in healthy:
+                order.append(hit)
+                self._affinity_hits += 1
+                self._affinity_hit_pages += pages
+        rest = [r for r in healthy if r not in order]
+        if self.fallback == "random":
+            self._rng.shuffle(rest)
+        else:
+            rest.sort(key=lambda rid: (loads.get(rid, 0), rid))
+        return order + rest
+
+    def submit(self, prompt, tokens_to_generate, **kw):
+        """Dispatch one request; the returned handle is the chosen
+        engine's own EngineRequest (rid + replica_id identify it
+        fleet-wide). Raises the last replica error — QueueFull only
+        when EVERY healthy replica's queue is full, FleetUnavailable
+        (a QueueFull: the HTTP layer's 503 + Retry-After) when no
+        replica is healthy at all."""
+        from megatron_llm_tpu.inference.engine import QueueFull
+
+        healthy, loads = self._probe()  # blocking I/O stays unlocked
+        if not healthy:
+            with self._lock:
+                self._rejected += 1
+            raise FleetUnavailable(
+                "router: no healthy replica (all poisoned/stopped "
+                "or cooling down) — the fleet cannot take traffic; "
+                "retry after the cooldown")
+        with self._lock:
+            order = self._pick(list(prompt), healthy, loads)
+            self._dispatches += 1
+        last_err: Optional[BaseException] = None
+        for i, rid in enumerate(order):
+            rep = self._by_id[rid]
+            try:
+                req = rep.submit(prompt, tokens_to_generate, **kw)
+            except QueueFull as e:
+                # this replica is full, the next may not be
+                last_err = e
+                with self._lock:
+                    self._failovers += 1 if i + 1 < len(order) else 0
+                continue
+            except ValueError:
+                # request-shaped error (oversize prompt etc.): every
+                # replica would refuse it identically — surface as-is
+                raise
+            except Exception as e:  # noqa: BLE001 — poisoned mid-dispatch
+                last_err = e
+                self._mark_down(rid, repr(e))
+                with self._lock:
+                    self._failovers += 1 if i + 1 < len(order) else 0
+                continue
+            with self._lock:
+                self._per_replica[rid] += 1
+                if self.affinity:
+                    self._index.register(list(prompt), rid)
+            return req
+        with self._lock:
+            self._rejected += 1
+        raise last_err if last_err is not None else RuntimeError(
+            "router: dispatch failed with no replica error")
+
+    def cancel(self, req) -> None:
+        rep = self._by_id.get(getattr(req, "replica_id", None))
+        if rep is None:
+            _logger.warning("router.cancel: request %r names no known "
+                            "replica", getattr(req, "rid", None))
+            return
+        rep.cancel(req)
+
+    # -- aggregated observability -----------------------------------------
+
+    def router_stats(self) -> dict:
+        with self._lock:
+            d = max(self._dispatches, 1)
+            return {
+                "router_replicas": len(self.replicas),
+                "router_affinity": self.affinity,
+                "router_fallback": self.fallback,
+                "router_dispatches": self._dispatches,
+                "router_affinity_hits": self._affinity_hits,
+                "router_affinity_hit_rate": round(
+                    self._affinity_hits / d, 4),
+                "router_affinity_hit_pages": self._affinity_hit_pages,
+                "router_failovers": self._failovers,
+                "router_rejected": self._rejected,
+                "router_index_entries": len(self._index),
+                "router_per_replica_dispatches": dict(self._per_replica),
+            }
+
+    def counters(self) -> dict:
+        """Fleet /metrics: router dispatch stats + additive engine
+        counters summed across replicas + per-replica detail under
+        "replicas" (keyed by replica id — each row carries its own
+        serve_replica_id). Non-additive gauges (percentiles, rates,
+        dtypes) stay per-replica only: summing a p95 would fabricate a
+        number; the fleet-wide distributions live in the MERGED
+        histograms on the Prometheus surface."""
+        per = {r.replica_id: r.counters() for r in self.replicas}
+        agg: dict = dict(self.router_stats())
+        # serve_kv_pool_bytes is PER-CHIP by contract (engine.py
+        # ISSUE 14 small fix) — the fleet sum scales each replica by
+        # its tp instead (fleet_kv_pool_bytes), under its own key so
+        # the two units can never be confused
+        agg["serve_kv_pool_bytes_fleet"] = sum(
+            r.fleet_kv_pool_bytes() for r in self.replicas)
+        additive = (
+            "serve_queue_depth",
+            "serve_pages_in_use", "serve_pages_free", "serve_admitted",
+            "serve_retired", "serve_timed_out", "serve_cancelled",
+            "serve_steps", "serve_tok_s", "serve_prefill_tokens",
+            "serve_prefix_hit_tokens", "serve_prefix_lookup_tokens",
+            "serve_prefix_hits", "serve_prefix_lookups",
+            "serve_prefix_cached_pages", "serve_prefix_shared_pages",
+            "serve_prefix_cow_copies", "serve_prefix_evicted_pages",
+        )
+        for key in additive:
+            vals = [c[key] for c in per.values() if key in c]
+            if vals:
+                agg[key] = round(sum(vals), 2)
+        agg["replicas"] = per
+        return agg
+
+    def health(self) -> dict:
+        """The router's load-balancer probe, same shape the server
+        expects from an engine: alive while ANY replica can take
+        traffic."""
+        per = {r.replica_id: r.health() for r in self.replicas}
+        alive = [rid for rid, h in per.items()
+                 if h["alive"] and h["broken"] is None]
+        return {
+            "alive": bool(alive),
+            "broken": None if alive else "all replicas down",
+            "queue_depth": sum(h["queue_depth"] for h in per.values()),
+            "slots_busy": sum(h["slots_busy"] for h in per.values()),
+            "replicas": per,
+        }
+
+    def histograms(self):
+        """Fleet-wide latency histograms: per-name bucket merge across
+        replicas (cumulative buckets are additive)."""
+        from megatron_llm_tpu.telemetry import Histogram
+
+        by_name: Dict[str, list] = {}
+        for rep in self.replicas:
+            for h in rep.histograms():
+                by_name.setdefault(h.name, []).append(h)
+        return [Histogram.merged(hs) for hs in by_name.values()]
+
+    def prometheus_metrics(self) -> str:
+        from megatron_llm_tpu.telemetry import render_prometheus
+
+        counters = {k: v for k, v in self.counters().items()
+                    if k not in ("replicas",
+                                 "router_per_replica_dispatches")}
+        return render_prometheus(counters, self.histograms())
+
+    def flight_record(self) -> dict:
+        return {"reason": "on-demand",
+                "router": self.router_stats(),
+                "replicas": {r.replica_id: r.flight_record()
+                             for r in self.replicas}}
+
+    def request_profile(self, rounds: int,
+                        trace_dir: Optional[str] = None,
+                        replica: int = 0) -> dict:
+        """Arm a profiler capture on ONE replica (jax.profiler is
+        process-global — arming N in-process engines at once would
+        collide; POST /profile defaults to replica 0)."""
+        rep = self._by_id.get(replica)
+        if rep is None or not hasattr(rep, "engine"):
+            return {"ok": False,
+                    "error": f"no in-process replica {replica}"}
+        return rep.engine.request_profile(rounds, trace_dir=trace_dir)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        for rep in self.replicas:
+            rep.start()
+        self._thread = object()  # duck-typed "started" (server.run)
+
+    def drain(self):
+        for rep in self.replicas:
+            rep.drain()
+
+    def stop(self, drain: bool = True):
+        for rep in self.replicas:
+            rep.stop(drain=drain)
+        self._thread = None

@@ -54,6 +54,15 @@ stream). Validation failures answer plain JSON before any bytes stream.
 A mid-stream client disconnect cancels the engine request: the slot
 retires and its pages return to the pool (prefix-cache refcounts
 intact). `stream_enabled=False` (`--no_stream`) turns the surface off.
+
+Replica fleets (ISSUE 14): `engine=` also accepts a `ReplicaRouter`
+(inference/router.py) — it duck-types the engine surface this module
+uses (submit/cancel/counters/health/prometheus_metrics/flight_record/
+start/stop + the admission limits), so the same handler serves N
+prefix-affinity-routed engine replicas: /metrics aggregates additive
+counters and merges in-process replicas' latency histograms, /health
+answers for the fleet (alive while any replica takes traffic), and the
+SSE `id:` field carries "replica-rid" so streams stay attributable.
 """
 
 from __future__ import annotations
@@ -473,6 +482,12 @@ class MegatronGenerate:
         except Exception as e:  # same jsonified-error contract as put()
             return {"message": repr(e)}, 500
 
+        # the SSE `id:` correlation key (ISSUE 13/14): rid alone on a
+        # standalone engine (the pinned legacy surface); "replica-rid"
+        # once the serving engine is a tagged replica behind the
+        # router, so N replicas' ids stay distinguishable client-side
+        sse_id = (req.rid if getattr(req, "replica_id", None) is None
+                  else f"{req.replica_id}-{req.rid}")
         out_ids = []
         # INCREMENTAL detokenization over a bounded tail window: decode
         # the pending tokens and emit the suffix delta — a per-token
@@ -523,7 +538,7 @@ class MegatronGenerate:
                         while win_emitted.endswith("�"):
                             win_emitted = win_emitted[:-1]
                 write_event({"token": int(t), "text": delta},
-                            rid=req.rid)
+                            rid=sse_id)
         except _queue.Empty:
             # stalled engine: reclaim the slot and tell the client
             # before closing — an EOF with no done event looks like a
@@ -535,7 +550,7 @@ class MegatronGenerate:
                 write_event({"done": True, "rid": req.rid,
                              "error": "timed out waiting for the "
                                       "engine; request cancelled"},
-                            rid=req.rid)
+                            rid=sse_id)
             except Exception:
                 pass
             return None
@@ -553,7 +568,7 @@ class MegatronGenerate:
             final = {"done": True, "rid": req.rid, "error": req.error}
         else:
             final["text"] = tok.detokenize(ids + out_ids)
-        write_event(final, rid=req.rid)
+        write_event(final, rid=sse_id)
         return None
 
 

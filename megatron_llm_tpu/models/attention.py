@@ -259,6 +259,17 @@ def attention_block(
       lengths[i] (s is the padded chunk width; 1 == a decode row, 0 ==
       idle), scattered + attended in one ragged pass
       (ops/prefill_attention.ragged_paged_prefill).
+
+    On a tp serving mesh (DecodeEngine(serving_tp>1), ISSUE 14) BOTH
+    paged forms run group-sharded with no changes here: the pools
+    arrive sharded on the group axis (kv_pool_spec), the existing
+    shard_activation("groups"/"heads") constraint sites steer q and
+    the attention output onto the same split, and GSPMD partitions the
+    scatter + attention per shard (each chip runs the kernels — or
+    their XLA twins — over its own groups against replicated page
+    tables/lengths). The wo matmul below is the step's one collective
+    (row-parallel partial-sum all-reduce, pinned by the tp2 audit
+    rows).
     """
     b, s, h = hidden.shape
     compute_dtype = cfg.compute_dtype

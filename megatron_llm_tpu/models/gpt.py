@@ -193,7 +193,8 @@ class GPTModel:
         return jnp.sum(loss_mask.astype(jnp.float32))
 
     def prepare_decode_params(self, params: dict,
-                              quantize_int8: bool = False) -> dict:
+                              quantize_int8: bool = False,
+                              flatten_glu: bool = True) -> dict:
         """Decode-layout view of the params, built ONCE before the token
         loop (called inside generate's jit, ahead of the while_loop):
 
@@ -214,16 +215,30 @@ class GPTModel:
           (ops/quantization.quantize_decode_layers); the decode matvecs
           read half the weight bytes. Biases/norms/embeddings/head stay
           fp — see the accuracy contract in docs/GUIDE.md ("Quantized
-          serving").
+          serving");
+        - `flatten_glu=False` (ISSUE 14, the tp-sharded serving
+          engine): keep the GLU weight in the training (h, 2, f)
+          layout. The flat (h, 2f) view concatenates [gate | up] along
+          exactly the axis tensor parallelism shards, so a contiguous
+          model split would separate gates from ups and force a
+          mid-MLP reshard; the unflattened layout shards f per chip
+          and keeps the GLU elementwise-local
+          (parallel/sharding.decode_param_specs). Single-chip engines
+          keep the flatten (the sublane-bandwidth win above).
         """
         import jax
 
+        if quantize_int8 and not flatten_glu:
+            raise ValueError(
+                "quantize_int8 requires the flattened GLU decode "
+                "layout (quantize_decode_layers quantizes the 2D "
+                "view); tp-sharded engines serve the fp decode tree")
         L = self.cfg.num_layers
         stacked = params["layers"]
 
         def layer_slice(i):
             layer = jax.tree.map(lambda x: x[i], stacked)
-            if self.cfg.glu_activation:
+            if self.cfg.glu_activation and flatten_glu:
                 mlp = dict(layer["mlp"])
                 w1 = mlp["w1"]
                 mlp["w1"] = w1.reshape(w1.shape[0], -1)
@@ -282,7 +297,8 @@ class GPTModel:
     def init_paged_kv_caches(self, slots: int, num_pages: int,
                              page_size: int,
                              max_pages_per_slot: int,
-                             kv_dtype=None) -> dict:
+                             kv_dtype=None,
+                             mesh_ctx=None) -> dict:
         """Paged KV cache for the continuous-batching engine
         (inference/engine.py): per-layer GLOBAL page pools
         (num_pages, page_size, g, d) shared by all slots, one
@@ -302,25 +318,70 @@ class GPTModel:
         one symmetric scale per (token, group), written by the same
         scatter paths that write the data and consumed in-register by
         the paged kernels — roughly halving the pool's bytes/token
-        (docs/GUIDE.md, "Quantized serving")."""
+        (docs/GUIDE.md, "Quantized serving").
+
+        `mesh_ctx` (ISSUE 14, the tp-sharded engine): a
+        ParallelContext whose `model` axis the pools shard over —
+        every pool leaf materialises DIRECTLY under its
+        kv_pool_spec sharding (group axis over `model`,
+        parallel/sharding.py — the per-chip pool is 1/tp the bytes,
+        never allocated whole on one chip), while the page table and
+        lengths stay replicated scalar-prefetch operands."""
         cfg = self.cfg
         kv_dtype = cfg.compute_dtype if kv_dtype is None else kv_dtype
         shape = (num_pages, page_size, cfg.num_query_groups, cfg.head_dim)
+
+        if mesh_ctx is not None:
+            import jax
+            import numpy as np
+
+            from megatron_llm_tpu.parallel.sharding import kv_pool_spec
+
+            tp = mesh_ctx.tp
+
+            def _sharded_zeros(shape, dtype, sh):
+                # per-shard host zeros straight onto each device — no
+                # whole-pool materialisation anywhere (the pool is the
+                # largest allocation serving makes), and no jit (this
+                # is a one-shot allocation, not a compile-contract
+                # entry point)
+                npdt = np.dtype(dtype)
+
+                def cb(idx):
+                    sub = [len(range(*s.indices(n)))
+                           for s, n in zip(idx, shape)]
+                    return np.zeros(sub, npdt)
+
+                return jax.make_array_from_callback(shape, sh, cb)
+
+            def zeros(shape, dtype):
+                return _sharded_zeros(
+                    shape, dtype,
+                    mesh_ctx.sharding(*kv_pool_spec(shape, tp)))
+
+            def zeros_rep(shape, dtype):
+                return _sharded_zeros(shape, dtype, mesh_ctx.sharding())
+        else:
+            def zeros(shape, dtype):
+                return jnp.zeros(shape, dtype)
+
+            zeros_rep = zeros
+
         caches = {
-            "k_pages_layers": tuple(jnp.zeros(shape, kv_dtype)
+            "k_pages_layers": tuple(zeros(shape, kv_dtype)
                                     for _ in range(cfg.num_layers)),
-            "v_pages_layers": tuple(jnp.zeros(shape, kv_dtype)
+            "v_pages_layers": tuple(zeros(shape, kv_dtype)
                                     for _ in range(cfg.num_layers)),
-            "page_table": jnp.zeros((slots, max_pages_per_slot),
+            "page_table": zeros_rep((slots, max_pages_per_slot),
                                     jnp.int32),
-            "lengths": jnp.zeros((slots,), jnp.int32),
+            "lengths": zeros_rep((slots,), jnp.int32),
         }
         if jnp.dtype(kv_dtype) == jnp.int8:
             sshape = shape[:-1]
             caches["k_scales_layers"] = tuple(
-                jnp.zeros(sshape, jnp.float32)
+                zeros(sshape, jnp.float32)
                 for _ in range(cfg.num_layers))
             caches["v_scales_layers"] = tuple(
-                jnp.zeros(sshape, jnp.float32)
+                zeros(sshape, jnp.float32)
                 for _ in range(cfg.num_layers))
         return caches

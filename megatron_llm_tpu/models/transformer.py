@@ -134,6 +134,11 @@ def mlp_block(mlp_params, cfg, hidden, dropout_rng, deterministic):
             x = qdot(hidden, w1, dt).reshape(b, s, 2, -1)
         else:
             # (b,s,h) @ (h,2,f) -> (b,s,2,f); gate/up on their own axis.
+            # Also the tp-sharded DECODE path (ISSUE 14): mesh engines
+            # keep this layout (prepare_decode_params(flatten_glu=
+            # False)) so f shards over `model` and the GLU combine
+            # stays elementwise-local per chip — the flat (h, 2f) view
+            # concatenates gate|up along exactly the sharded axis.
             x = jnp.einsum("bsh,hcf->bscf", hidden, w1.astype(dt))
         if "b1" in mlp_params:
             x = x + mlp_params["b1"].astype(dt)

@@ -106,6 +106,21 @@ def axis_size(name) -> int:
 
 _CONTEXT: Optional["ParallelContext"] = None
 
+# Thread-local context OVERRIDE (ISSUE 14): `use_mesh` scopes are
+# per-thread, so N tp-serving engines' serve threads can each trace
+# under their OWN mesh concurrently — a process-global swap would make
+# one replica bake another's mesh into its constraints (or force a
+# fleet-serializing lock around every dispatch). Reads fall back to
+# the installed global (`initialize_parallel`), which trainers and
+# tests keep using unchanged.
+import threading as _threading
+
+_TLS = _threading.local()
+
+
+def _effective_context() -> Optional["ParallelContext"]:
+    return getattr(_TLS, "ctx", None) or _CONTEXT
+
 
 def maybe_initialize_distributed() -> int:
     """Multi-host bring-up — the analogue of the reference's
@@ -218,7 +233,7 @@ def initialize_parallel(
 
 
 def get_context() -> Optional[ParallelContext]:
-    return _CONTEXT
+    return _effective_context()
 
 
 def destroy_parallel() -> None:
@@ -229,14 +244,15 @@ def destroy_parallel() -> None:
 
 @contextlib.contextmanager
 def use_mesh(ctx: ParallelContext):
-    """Temporarily install a context (tests use this to swap meshes)."""
-    global _CONTEXT
-    prev = _CONTEXT
-    _CONTEXT = ctx
+    """Temporarily install a context for THIS thread (tests use this
+    to swap meshes; tp serving engines scope every dispatch with it).
+    Thread-local by design — see _effective_context."""
+    prev = getattr(_TLS, "ctx", None)
+    _TLS.ctx = ctx
     try:
         yield ctx
     finally:
-        _CONTEXT = prev
+        _TLS.ctx = prev
 
 
 # ---------------------------------------------------------------------------
@@ -329,7 +345,7 @@ _fusion_barrier.defvjp(_fusion_barrier_fwd, _fusion_barrier_bwd)
 
 
 def shard_activation(x, kind: str):
-    ctx = _CONTEXT
+    ctx = _effective_context()
     if ctx is None or _MANUAL_DEPTH:
         if ctx is not None and _BARRIER_DEPTH:
             return _fusion_barrier(x)

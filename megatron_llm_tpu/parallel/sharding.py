@@ -197,6 +197,115 @@ def optimizer_state_specs(cfg, params: dict, dp: int, distributed: bool,
     return jax.tree.unflatten(treedef, out)
 
 
+def kv_pool_axis(shape: tuple, tp: int) -> Optional[int]:
+    """The leaf axis the tp-sharded serving engine shards a paged KV
+    pool over `model`: the GROUP axis — index 2 of both the
+    (num_pages, page_size, g, d) data pools and the (num_pages,
+    page_size, g) int8 scale pools — when it divides by tp, else None
+    (replicated). The ONE divisibility rule for serving pools, the
+    zero1_axis idiom applied to the KV cache: kv_pool_spec, the
+    engine's pool allocation (inference/engine.py), and the tp2 audit
+    rows (analysis/audit.py) all derive from this so they can never
+    disagree on which pool leaves are sharded. Pages and page offsets
+    stay unsharded on purpose — the page table is a replicated
+    host-trivial scalar-prefetch operand, so every chip addresses the
+    same page ids and only the per-(group) blocks it owns."""
+    if tp <= 1:
+        return None
+    if len(shape) < 3 or shape[2] % tp != 0 or shape[2] < tp:
+        return None
+    return 2
+
+
+def kv_pool_spec(shape: tuple, tp: int) -> P:
+    """PartitionSpec for one paged-pool leaf under serving tp (see
+    kv_pool_axis): group axis over `model`, everything else —
+    num_pages, page_size, head_dim — replicated per chip."""
+    k = kv_pool_axis(shape, tp)
+    if k is None:
+        return P()
+    parts: list = [None] * len(shape)
+    parts[k] = MODEL_AXIS
+    return P(*parts)
+
+
+def decode_param_specs(cfg, dec_params: dict) -> dict:
+    """PartitionSpec pytree for the DECODE-layout param tree
+    (GPTModel.prepare_decode_params: the stacked (L, ...) layer tree
+    split into a tuple of per-layer dicts) — the param_specs rules with
+    the leading layer axis removed, for the tp-sharded serving engine
+    (inference/engine.py serving_tp > 1):
+
+    - wqkv / b1 (glu (2, f)) column-parallel: output dim over `model`
+    - wo / w2 row-parallel: input dim over `model`
+    - w1 in the UNFLATTENED (h, 2, f) GLU layout: f over `model`. The
+      single-chip decode flatten to (h, 2f) concatenates [gate | up]
+      along the sharded axis, so a contiguous model split would hand
+      chip 0 all gates and chip 1 all ups and force a reshard before
+      the elementwise GLU — tp engines keep the training layout
+      (prepare_decode_params(flatten_glu=False)).
+    - embedding / lm_head vocab-parallel; norms and small biases
+      replicated (same rules as param_specs).
+    """
+
+    def layer(tree: dict) -> dict:
+        specs: dict = {
+            "input_norm": jax.tree.map(lambda _: P(), tree["input_norm"]),
+        }
+        attn = {"wqkv": P(None, MODEL_AXIS), "wo": P(MODEL_AXIS, None)}
+        if "bqkv" in tree["attention"]:
+            attn["bqkv"] = P(MODEL_AXIS)
+            attn["bo"] = P(None)
+        specs["attention"] = attn
+        w1 = tree["mlp"]["w1"]
+        if cfg.glu_activation:
+            assert getattr(w1, "ndim", 3) == 3, (
+                "tp-sharded decode params need the UNFLATTENED (h, 2, f) "
+                "GLU layout (prepare_decode_params(flatten_glu=False)): "
+                "the flat (h, 2f) layout concatenates gate|up along the "
+                "axis tp would shard")
+            mlp = {"w1": P(None, None, MODEL_AXIS),
+                   "w2": P(MODEL_AXIS, None)}
+            if "b1" in tree["mlp"]:
+                mlp["b1"] = P(None, MODEL_AXIS)
+                mlp["b2"] = P(None)
+        else:
+            mlp = {"w1": P(None, MODEL_AXIS), "w2": P(MODEL_AXIS, None)}
+            if "b1" in tree["mlp"]:
+                mlp["b1"] = P(MODEL_AXIS)
+                mlp["b2"] = P(None)
+        specs["mlp"] = mlp
+        for name in ("post_attention_norm", "mlp_norm"):
+            if name in tree:
+                specs[name] = jax.tree.map(lambda _: P(), tree[name])
+        return specs
+
+    specs: dict = {}
+    for key, val in dec_params.items():
+        if key == "layers":
+            specs[key] = tuple(layer(l) for l in val)
+        elif key == "embedding":
+            emb = {"word_embeddings": P(MODEL_AXIS, None)}
+            for name in ("position_embeddings", "tokentype_embeddings"):
+                if name in val:
+                    emb[name] = P(None, None)
+            specs[key] = emb
+        elif key == "lm_head" and not isinstance(val, dict):
+            specs[key] = P(None, MODEL_AXIS)
+        else:
+            specs[key] = jax.tree.map(lambda _: P(), val)
+    return specs
+
+
+def decode_param_shardings(ctx: ParallelContext, cfg,
+                           dec_params: dict) -> dict:
+    return jax.tree.map(
+        lambda spec: NamedSharding(ctx.mesh, spec),
+        decode_param_specs(cfg, dec_params),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def batch_specs() -> P:
     """(batch, seq) host batch: batch dim over data axis."""
     return P(DATA_AXIS, None)

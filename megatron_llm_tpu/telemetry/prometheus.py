@@ -96,6 +96,28 @@ class Histogram:
         out.append((float("inf"), count))
         return out
 
+    @classmethod
+    def merged(cls, histograms: Iterable["Histogram"]) -> "Histogram":
+        """Sum same-named, same-bucket histograms from N engines into
+        one — cumulative buckets are additive by design (the module
+        docstring's aggregatability claim made executable): the
+        replica router's GET /metrics serves fleet-wide TTFT/decode
+        distributions this way (inference/router.py, ISSUE 14)."""
+        hs = list(histograms)
+        assert hs, "merged() needs at least one histogram"
+        first = hs[0]
+        out = cls(first.name, buckets=first.bounds,
+                  help_text=first.help_text)
+        for h in hs:
+            assert h.name == first.name and h.bounds == first.bounds, (
+                "merging histograms with different names/buckets would "
+                "fabricate a distribution", h.name, first.name)
+            cells, s, c = h._snapshot()
+            out._cells = [a + b for a, b in zip(out._cells, cells)]
+            out._sum += s
+            out._count += c
+        return out
+
     def to_prom_lines(self, prefix: str = "") -> List[str]:
         name = prefix + self.name
         lines = []

@@ -42,9 +42,15 @@ __all__ = ["FlightRecorder"]
 class FlightRecorder:
     """Bounded structured-event ring with crash-dump export."""
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096,
+                 base: Optional[dict] = None):
         assert capacity >= 16, "a flight record needs some history"
         self.capacity = capacity
+        # `base`: fields stamped into EVERY event (ISSUE 14: the
+        # engine's replica id — N replicas' aggregated dumps must stay
+        # attributable at the router). None keeps the event schema
+        # byte-identical to the standalone recorder's.
+        self._base = dict(base) if base else {}
         self._events: deque = deque(maxlen=capacity)
         # serializes ring mutation vs snapshot(): GET /flight_record
         # iterates the ring from an HTTP thread while the serve loop
@@ -65,7 +71,7 @@ class FlightRecorder:
         scalars/strings — the recorder never touches a device value.
         The lock is uncontended on the hot path (snapshot() holds it
         only for a ring copy)."""
-        ev = {"t": time.time(), "kind": kind, **fields}
+        ev = {"t": time.time(), "kind": kind, **self._base, **fields}
         with self._lock:
             if len(self._events) == self.capacity:
                 self.dropped += 1

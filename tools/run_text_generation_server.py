@@ -118,6 +118,34 @@ def main():
                    help="bounded ring of recent structured engine "
                         "events (rounds, admissions, retirements) the "
                         "flight recorder keeps")
+    # ISSUE 14: serve from a mesh, not a chip (docs/GUIDE.md "Serving
+    # on a tp mesh & replica routing")
+    p.add_argument("--serving_tp", type=int, default=1,
+                   help="tensor-parallel degree of EACH engine's "
+                        "serving mesh: the KV page pools (and int8 "
+                        "scale pools) shard over the head/group axis "
+                        "and every jitted step runs under pjit/GSPMD "
+                        "on a (1,1,1,tp) mesh; must divide the "
+                        "model's num_query_groups. Greedy token "
+                        "streams stay bitwise vs single-chip; 1 = "
+                        "single-chip (the default)")
+    p.add_argument("--router_replicas", type=int, default=1,
+                   help="run N engine replicas behind the prefix-"
+                        "affinity router (inference/router.py): each "
+                        "replica owns serving_tp devices "
+                        "(replica i -> devices [i*tp, (i+1)*tp)), "
+                        "shared-prefix traffic routes to the replica "
+                        "whose PrefixCache holds the pages, fallback "
+                        "least-queue-depth, poisoned replicas leave "
+                        "rotation, stop drains the fleet. /metrics "
+                        "aggregates; 1 = one engine, no router")
+    p.add_argument("--affinity_routing",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="route by the page-aligned prefix -> replica "
+                        "index (--no_affinity_routing = pure least-"
+                        "queue-depth dispatch, the A/B control arm "
+                        "bench extra.serving.scaleout measures "
+                        "against)")
     args = p.parse_args()
 
     import jax
@@ -179,30 +207,70 @@ def main():
         # reaches the engine ctor's loud incompatibility error.
         prefix_cache = (args.prefix_cache if args.prefix_cache is not None
                         else args.prefill_chunk_tokens > 0)
-        engine = DecodeEngine(
-            model, params, slots=args.serving_slots,
-            page_size=args.page_size, max_context=args.max_context,
-            page_budget=args.page_budget, max_queue=args.max_queue,
-            step_horizon=args.step_horizon,
-            prefill_chunk_tokens=args.prefill_chunk_tokens,
-            warmup_compile=args.warmup_compile,
-            prefix_cache=prefix_cache,
-            spec_decode_k=args.spec_decode_k,
-            kv_dtype=args.kv_dtype,
-            quantize_weights=args.quantize_weights,
-            termination_id=tokenizer.eod,
-            vocab_size=tokenizer.vocab_size,
-            trace_dir=args.trace_dir,
-            record_dir=args.record_dir,
-            flight_recorder_size=args.flight_recorder_size,
-        )
+        n_rep, tp = max(args.router_replicas, 1), max(args.serving_tp, 1)
+        if n_rep * tp > len(jax.devices()):
+            raise SystemExit(
+                f"--router_replicas {n_rep} x --serving_tp {tp} needs "
+                f"{n_rep * tp} devices, have {len(jax.devices())}")
+
+        def build_engine(replica_id=None, devices=None):
+            return DecodeEngine(
+                model, params, slots=args.serving_slots,
+                page_size=args.page_size, max_context=args.max_context,
+                page_budget=args.page_budget, max_queue=args.max_queue,
+                step_horizon=args.step_horizon,
+                prefill_chunk_tokens=args.prefill_chunk_tokens,
+                warmup_compile=args.warmup_compile,
+                prefix_cache=prefix_cache,
+                spec_decode_k=args.spec_decode_k,
+                kv_dtype=args.kv_dtype,
+                quantize_weights=args.quantize_weights,
+                serving_tp=tp if tp > 1 else 1,
+                devices=devices,
+                replica_id=replica_id,
+                termination_id=tokenizer.eod,
+                vocab_size=tokenizer.vocab_size,
+                trace_dir=args.trace_dir,
+                record_dir=args.record_dir,
+                flight_recorder_size=args.flight_recorder_size,
+            )
+
+        if n_rep > 1:
+            # N replicas behind the prefix-affinity router: replica i
+            # owns the device block [i*tp, (i+1)*tp)
+            from megatron_llm_tpu.inference.router import (
+                EngineReplica,
+                ReplicaRouter,
+            )
+
+            replicas = [
+                EngineReplica(build_engine(
+                    replica_id=i,
+                    devices=jax.devices()[i * tp:(i + 1) * tp]))
+                for i in range(n_rep)
+            ]
+            engine = ReplicaRouter(replicas,
+                                   affinity=args.affinity_routing)
+        else:
+            engine = build_engine(
+                devices=jax.devices()[:tp] if tp > 1 else None)
+    serve_target = engine  # what MegatronServer gets (router or engine)
+    fleet = ""
+    if engine is not None and hasattr(engine, "replicas"):
+        # router: per-engine facts from replica 0 (homogeneous fleet)
+        engine = engine.replicas[0].engine
+        fleet = (f"{len(serve_target.replicas)} replicas x tp{tp} "
+                 f"(prefix-affinity routing "
+                 f"{'ON' if args.affinity_routing else 'OFF'}), ")
+    elif engine is not None and engine.serving_tp > 1:
+        fleet = f"tp{engine.serving_tp} mesh, "
     print(f"serving {args.model} from {path} on "
           f"http://{args.host}:{args.port}/api"
-          + (f" (continuous batching: {args.serving_slots} slots, "
+          + (f" ({fleet}continuous batching: {args.serving_slots} slots, "
              f"{engine.num_pages - 1} pages x {args.page_size}, "
              f"kv_dtype={engine.kv_pool_dtype()} "
-             f"({engine.kv_pool_bytes() / 2**20:.0f} MiB pool, "
-             f"{engine.kv_bytes_per_token()} B/token), "
+             f"({engine.kv_pool_bytes() / 2**20:.0f} MiB/chip pool, "
+             f"{engine.kv_bytes_per_token()} B/token/chip), "
              + ("int8 decode weights, " if engine.quantize_weights
                 else "")
              + (f"chunked prefill {engine.prefill_chunk_tokens} tok/round"
@@ -218,7 +286,7 @@ def main():
                "/health, flight record at /flight_record, profiler at "
                "POST /profile)"
              if engine else " (whole-batch, no engine)"), flush=True)
-    MegatronServer(model, params, tokenizer, engine=engine,
+    MegatronServer(model, params, tokenizer, engine=serve_target,
                    request_deadline_s=args.request_deadline_s,
                    stream_enabled=args.stream).run(
         args.host, args.port)
