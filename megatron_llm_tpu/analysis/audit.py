@@ -19,12 +19,14 @@ a production shape exists.
 
 Entry points audited (the registry's lowerable surface):
 - the five engine builders, through `DecodeEngine.audit_entry_points()`
-  against the engine's REAL pools — FOUR times: an fp engine, an
-  int8-KV + weight-only-int8 engine (ISSUE 9), and a telemetry-on
-  engine (ISSUE 13: live span tracer + flight recorder around the
-  mint; _check_telemetry_parity pins its artifacts identical to the fp
-  engine's — inventory equality, zero host callbacks — so telemetry
-  can never leak into jitted code), all at mesh tag "single"; plus a
+  against the engine's REAL pools — FIVE times: an fp engine, an
+  int8-KV + weight-only-int8 engine (ISSUE 9), a telemetry-on engine
+  (ISSUE 13: live span tracer + flight recorder around the mint), and
+  a cost-registry-on engine (ISSUE 15: mint-time compiled-cost capture
+  live; _check_telemetry_parity pins both instrumented engines'
+  artifacts identical to the fp engine's — inventory equality, zero
+  host callbacks, equal FLOPs — so neither telemetry nor cost capture
+  can ever leak into jitted code), all at mesh tag "single"; plus a
   tp2-MESH engine (ISSUE 14: group-sharded pools under pjit/GSPMD)
   whose per-contract "tp2" collective inventories are pinned —
   all-reduce only for the forward steps, zero collectives for the
@@ -184,6 +186,22 @@ def audit_lowered(name: str, mesh_tag: str, fn, args: tuple,
 
     res.facts["overlap"] = collective_overlap_report(text).to_dict()
 
+    # compiled-cost facts (ISSUE 15): the per-contract FLOPs/bytes the
+    # `graft_check.py costs` regression gate diffs against its
+    # baseline, through the ONE list-vs-dict normalization the
+    # CostRegistry's capture path uses (a JAX return-shape change must
+    # break both consumers at once, not one silently)
+    try:
+        from megatron_llm_tpu.telemetry.costs import _analysis_dict
+
+        d = _analysis_dict(compiled.cost_analysis())
+        if "flops" in d:
+            res.facts["flops"] = int(d["flops"])
+        if "bytes accessed" in d:
+            res.facts["bytes_accessed"] = int(d["bytes accessed"])
+    except Exception:  # noqa: BLE001 — backend without cost analysis
+        pass
+
     try:
         mem = compiled.memory_analysis()
         tmp = int(mem.temp_size_in_bytes)
@@ -279,6 +297,28 @@ def _audit_engine() -> List[TargetResult]:
             res = audit_lowered(name, "single", fn, args)
         eng_t.recorder.record("audit_lower", contract=name)
         res.facts["telemetry"] = True
+        results.append(res)
+    # cost-registry-on engine (ISSUE 15): every mint ran the mint-time
+    # capture (lower + compile for cost/memory analysis) — the audited
+    # artifacts must be IDENTICAL to the plain fp engine's
+    # (_check_telemetry_parity pins it), so cost capture can never
+    # perturb what traffic runs. The row also proves capture actually
+    # happened: a registry that silently captured nothing would make
+    # every cost gauge a fiction.
+    eng_c = DecodeEngine(
+        model, params, slots=2, page_size=16, max_context=64,
+        step_horizon=8, prefill_chunk_tokens=16, spec_decode_k=2,
+        vocab_size=256, cost_registry=True, chip_spec="v5e")
+    for name, fn, args in eng_c.audit_entry_points():
+        res = audit_lowered(name, "single", fn, args)
+        res.facts["costs"] = True
+        res.facts["cost_records"] = eng_c.costs.captures
+        if eng_c.costs.captures == 0:
+            res.fail(
+                "cost_registry engine minted entry points but the "
+                "CostRegistry captured no records — the mint-time "
+                "capture hook (contracts.add_mint_listener + "
+                "engine._capture_cost) is broken")
         results.append(res)
     # tp2-mesh engine (ISSUE 14): the five entry points lowered on a
     # (1,1,1,2) serving mesh against group-sharded pools — the
@@ -392,6 +432,11 @@ def _audit_train_step(mesh_tag: str) -> TargetResult:
     # artifact must be identical to the base row's
     # (_check_telemetry_parity); telemetry is host-side by contract.
     telemetry = "+telemetry" in mesh_tag
+    # "+costs" (ISSUE 15): the same build minted with a live attached
+    # CostRegistry capturing the step's compiled cost — exactly the
+    # trainer's --device_cost_registry instrumentation; same parity
+    # contract as +telemetry.
+    costs = "+costs" in mesh_tag
     cfg = _audit_train_config(num_layers=4 if overlap else 2)
     model = LlamaModel(cfg)
     ctx = initialize_parallel(dp=dp, pp=1, tp=tp)
@@ -455,6 +500,26 @@ def _audit_train_step(mesh_tag: str) -> TargetResult:
         # stays None — the no-dropout config's own specialization.
         lower_args = (params, opt_state, batch, jnp.float32(1e-4),
                       jnp.float32(0.0), None, jnp.float32(np.inf))
+        if costs:
+            from megatron_llm_tpu.telemetry import CostRegistry
+
+            registry = CostRegistry().attach()
+            try:
+                rec = registry.capture("train.step", ("audit", mesh_tag),
+                                       step, lower_args)
+                res = audit_lowered("train.step", mesh_tag, step,
+                                    lower_args)
+            finally:
+                registry.detach()
+            res.facts["costs"] = True
+            res.facts["cost_records"] = registry.captures
+            if rec is None or rec.flops is None:
+                res.fail(
+                    "+costs row: CostRegistry.capture returned no FLOPs "
+                    "for the train step — the mint-time capture path "
+                    "the trainer's --device_cost_registry rides is "
+                    "broken")
+            return res
         if not telemetry:
             return audit_lowered("train.step", mesh_tag, step,
                                  lower_args)
@@ -733,49 +798,63 @@ def _check_overlap_schedule(results: List[TargetResult]) -> None:
 
 
 def _check_telemetry_parity(results: List[TargetResult]) -> None:
-    """ISSUE 13 acceptance: specializations lowered with telemetry live
-    (span tracer + flight recorder recording around the mint) must be
-    the SAME compiled program family as telemetry-off — identical
-    collective inventory, zero host callbacks, same fp64 verdict. All
-    telemetry emission is host bookkeeping outside jit by design; this
-    pin turns that design rule into a gate, so threading a span or an
-    event into a jitted step (the classic io_callback 'just log it from
-    the device' shortcut) fails the audit instead of a production run."""
-    # engine rows: telemetry-on vs the plain fp engine, per contract
+    """ISSUE 13 + 15 acceptance: specializations lowered with telemetry
+    live (span tracer + flight recorder around the mint) OR with the
+    cost registry capturing (the ISSUE 15 mint-time hook) must be the
+    SAME compiled program family as the plain rows — identical
+    collective inventory, zero host callbacks, same fp64 verdict, and
+    (cost rows) identical compiled FLOPs: capture reads the artifact,
+    it may never change it. All emission is host bookkeeping outside
+    jit by design; this pin turns that design rule into a gate, so
+    threading a span, an event, or a cost probe into a jitted step
+    fails the audit instead of a production run."""
+    # engine rows: telemetry-on / cost-on vs the plain fp engine
     base: Dict[str, TargetResult] = {}
     for r in results:
         if (r.contract.startswith("engine.")
                 and "telemetry" not in r.facts
+                and "costs" not in r.facts
                 and "quantized" not in r.facts):
             base.setdefault(r.contract, r)
     pairs = [(r, base.get(r.contract)) for r in results
              if r.contract.startswith("engine.")
-             and r.facts.get("telemetry")]
-    # train.step: the +telemetry tag vs its base tag
+             and (r.facts.get("telemetry") or r.facts.get("costs"))]
+    # train.step: the +telemetry / +costs tags vs their base tag
     by_tag = {r.mesh_tag: r for r in results
               if r.contract == "train.step"}
     for tag, r in by_tag.items():
-        if tag.endswith("+telemetry"):
-            pairs.append((r, by_tag.get(tag[:-len("+telemetry")])))
+        for suffix in ("+telemetry", "+costs"):
+            if tag.endswith(suffix):
+                pairs.append((r, by_tag.get(tag[:-len(suffix)])))
     for r, b in pairs:
+        what = "cost-registry-on" if r.facts.get("costs") \
+            else "telemetry-on"
         if b is None:
-            r.fail("no telemetry-off twin row to compare against — "
-                   "the parity pin needs both specializations lowered")
+            r.fail(f"no plain twin row to compare the {what} "
+                   f"specialization against — the parity pin needs "
+                   f"both lowered")
             continue
         if r.facts.get("collectives") != b.facts.get("collectives"):
             r.fail(
-                f"telemetry-on collective inventory "
-                f"{r.facts.get('collectives')} != telemetry-off "
-                f"{b.facts.get('collectives')} ({b.mesh_tag}): telemetry "
-                f"leaked into the jitted program — emission must stay "
-                f"host-side (telemetry/ module contract)")
+                f"{what} collective inventory "
+                f"{r.facts.get('collectives')} != plain "
+                f"{b.facts.get('collectives')} ({b.mesh_tag}): "
+                f"instrumentation leaked into the jitted program — "
+                f"emission must stay host-side (telemetry/ contract)")
         if r.facts.get("host_callbacks"):
             r.fail(
-                f"telemetry-on specialization lowered host callbacks "
-                f"{r.facts['host_callbacks']}: a span/event emitter is "
+                f"{what} specialization lowered host callbacks "
+                f"{r.facts['host_callbacks']}: an emitter/probe is "
                 f"being called FROM traced code")
         if r.facts.get("f64") != b.facts.get("f64"):
-            r.fail("telemetry-on fp64 verdict differs from telemetry-off")
+            r.fail(f"{what} fp64 verdict differs from the plain row")
+        if (r.facts.get("costs") and "flops" in r.facts
+                and "flops" in b.facts
+                and r.facts["flops"] != b.facts["flops"]):
+            r.fail(
+                f"cost-registry-on compiled FLOPs {r.facts['flops']} "
+                f"!= plain {b.facts['flops']}: the capture perturbed "
+                f"the artifact it claims to measure")
 
 
 def audit_repo(root: str) -> dict:
@@ -792,7 +871,8 @@ def audit_repo(root: str) -> dict:
     # decomposition's collective inventory (reduce-scatter on the
     # pure-dp mesh; the quantized variant's all-to-all) and the
     # dp-sharded optimizer-state args bytes below.
-    for tag in ("tp2", "dp2", "dp2+telemetry", "dp2+zero1",
+    for tag in ("tp2", "dp2", "dp2+telemetry", "dp2+costs",
+                "dp2+zero1",
                 "dp2+zero1-quant",
                 "dp2+zero1+overlap", "dp2+zero1-quant+overlap",
                 "dp2tp2", "dp2tp2+zero1"):

@@ -47,6 +47,8 @@ __all__ = [
     "total_live_variants",
     "get_builder",
     "jit_cache_size",
+    "add_mint_listener",
+    "remove_mint_listener",
 ]
 
 
@@ -120,6 +122,31 @@ _BUILDERS: Dict[str, Callable] = {}
 _GLOBAL = "<global>"
 _VARIANTS: Dict[str, Dict[Any, Dict[Any, None]]] = {}
 
+# Mint listeners (ISSUE 15): callbacks fired once per NEW variant
+# record_variant accepts — the hook telemetry/costs.CostRegistry rides
+# so the compiled-cost inventory mirrors the executable inventory
+# exactly (mint-time only; nothing fires on cache hits or releases).
+# Fired OUTSIDE the registry lock: a listener may take its own locks.
+_MINT_LISTENERS: list = []
+
+
+def add_mint_listener(cb) -> None:
+    """Register cb(name, key, owner), called once per newly recorded
+    variant. Listeners must be cheap host bookkeeping (they run at the
+    mint site, which may sit inside a serving round's lazy trace) and
+    must never raise — exceptions propagate to the minting caller."""
+    with _LOCK:
+        if cb not in _MINT_LISTENERS:
+            _MINT_LISTENERS.append(cb)
+
+
+def remove_mint_listener(cb) -> None:
+    with _LOCK:
+        try:
+            _MINT_LISTENERS.remove(cb)
+        except ValueError:
+            pass
+
 
 def register_contract(contract: CompileContract,
                       builder: Optional[Callable] = None) -> CompileContract:
@@ -176,6 +203,7 @@ def record_variant(name: str, key: Any, owner: Any = None,
     contract = get_contract(name)
     limits = [b for b in (budget, contract.max_variants) if b is not None]
     limit = min(limits) if limits else None
+    listeners = ()
     with _LOCK:
         token = _owner_token(owner)
         per_name = _VARIANTS.setdefault(name, {})
@@ -205,7 +233,10 @@ def record_variant(name: str, key: Any, owner: Any = None,
                 f"must be updated WITH justification "
                 f"(docs/GUIDE.md, 'Static analysis & compile contracts')")
         bucket[key] = None
-        return True
+        listeners = tuple(_MINT_LISTENERS)
+    for cb in listeners:  # outside the lock, new mints only
+        cb(name, key, owner)
+    return True
 
 
 def release_variant(name: str, key: Any, owner: Any = None) -> bool:

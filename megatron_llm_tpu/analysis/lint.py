@@ -89,6 +89,15 @@ HOT_PATHS: Dict[str, Set[str]] = {
         "DecodeEngine._spec_round",
         "DecodeEngine._book_token",
         "DecodeEngine._admit",
+        # ISSUE 15 device-cost accounting: per-retire cost record,
+        # per-round modeled-vs-measured note, per-round sentinel feed —
+        # pricing a round must never cost a transfer (the mint-time
+        # registry record exists so it doesn't). Fixtures
+        # gr006_cost_{good,bad}.py pin the pattern.
+        "DecodeEngine._retire",
+        "DecodeEngine._request_cost",
+        "DecodeEngine._note_dispatch",
+        "DecodeEngine._sentinel_observe",
     },
     "megatron_llm_tpu/training/trainer.py": {
         "Trainer.train_step",
@@ -115,6 +124,24 @@ HOT_PATHS: Dict[str, Set[str]] = {
     },
     "megatron_llm_tpu/telemetry/prometheus.py": {
         "Histogram.observe",
+    },
+    # ISSUE 15 goodput/cost/sentinel emit sites: per-step ledger adds,
+    # per-round registry lookups + roofline math, per-step/round
+    # sentinel verdicts — all pure host arithmetic by contract (the
+    # mint-time capture is the ONLY place the registry touches jax,
+    # and it is not on these paths)
+    "megatron_llm_tpu/telemetry/goodput.py": {
+        "GoodputLedger.note",
+        "GoodputLedger.wall_s",
+    },
+    "megatron_llm_tpu/telemetry/costs.py": {
+        "CostRegistry.record",
+        "CostRecord.modeled_seconds",
+    },
+    "megatron_llm_tpu/telemetry/sentinel.py": {
+        "PerfSentinel.observe",
+        "RobustWindow.push",
+        "RobustWindow.threshold",
     },
 }
 

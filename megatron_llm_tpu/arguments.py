@@ -475,6 +475,39 @@ def build_base_parser() -> argparse.ArgumentParser:
                    help="bounded ring of recent structured events the "
                         "flight recorder keeps (per-step/lifecycle; "
                         "the crash artifact's history depth)")
+    # goodput & device-cost accounting (ISSUE 15, telemetry/chipspec +
+    # costs + goodput + sentinel; docs/GUIDE.md "Goodput & device-cost
+    # accounting"). The goodput ledger itself is always on.
+    g.add_argument("--device_cost_registry", action="store_true",
+                   help="capture each train-step specialization's "
+                        "compiled cost (cost_analysis FLOPs/bytes + "
+                        "memory_analysis temp/args) at mint time into "
+                        "the CostRegistry: upgrades the live MFU gauge "
+                        "from the analytic 6N model to registry FLOPs "
+                        "and adds the per-executable achieved-GB/s "
+                        "roofline gauge. Costs one extra AOT compile "
+                        "per step specialization")
+    g.add_argument("--chip_spec", type=str, default=None,
+                   choices=["v5e", "v5p", "v4"],
+                   help="override TPU-generation detection for the "
+                        "MFU/roofline denominators (telemetry/"
+                        "chipspec.py table; default: detect from "
+                        "jax.devices(), gauges absent when unknown)")
+    g.add_argument("--perf_sentinel_ksigma", type=float, default=0.0,
+                   help="arm the step-latency perf-regression "
+                        "sentinel: a step_ms above median + ksigma * "
+                        "1.4826*MAD of the recent window is bad; "
+                        "patience consecutive bad steps trip it — "
+                        "flight-recorder trail + ring auto-dump, the "
+                        "watchdog's postmortem path. 0 disables "
+                        "(default)")
+    g.add_argument("--perf_sentinel_window", type=int, default=64,
+                   help="sliding window of good step_ms samples the "
+                        "sentinel's median+MAD baseline is computed "
+                        "over")
+    g.add_argument("--perf_sentinel_patience", type=int, default=8,
+                   help="consecutive bad steps that escalate to a "
+                        "sentinel trip (ring auto-dump + counter)")
 
     # reference flags whose behavior is unconditionally provided (accepted,
     # recorded) or descoped (rejected in args_to_configs with the reason).
@@ -715,6 +748,11 @@ def args_to_configs(args, padded_vocab_size: int):
         trace_dir=args.trace_dir,
         flight_record_dir=args.flight_record_dir,
         flight_recorder_size=args.flight_recorder_size,
+        device_cost_registry=args.device_cost_registry,
+        chip_spec=args.chip_spec,
+        perf_sentinel_ksigma=args.perf_sentinel_ksigma,
+        perf_sentinel_window=args.perf_sentinel_window,
+        perf_sentinel_patience=args.perf_sentinel_patience,
         seed=args.seed,
     )
 

@@ -568,6 +568,21 @@ class TrainConfig:
     trace_dir: Optional[str] = None
     flight_record_dir: Optional[str] = None
     flight_recorder_size: int = 4096
+    # goodput & device-cost accounting (ISSUE 15, docs/GUIDE.md
+    # "Goodput & device-cost accounting"): the goodput ledger is
+    # ALWAYS on (pure host float adds); device_cost_registry opts into
+    # mint-time compiled-cost capture (one extra AOT compile per step
+    # specialization) which upgrades the live MFU gauge from analytic
+    # to registry FLOPs and adds per-executable roofline gauges;
+    # chip_spec overrides chipspec detection ("v5e"/"v5p"/"v4" — the
+    # roofline denominators); perf_sentinel_ksigma > 0 arms the
+    # step-latency regression sentinel (median+MAD, the watchdog's
+    # machinery) with its flight-ring auto-dump.
+    device_cost_registry: bool = False
+    chip_spec: Optional[str] = None
+    perf_sentinel_ksigma: float = 0.0
+    perf_sentinel_window: int = 64
+    perf_sentinel_patience: int = 8
 
     seed: int = 1234
 

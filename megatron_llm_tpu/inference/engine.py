@@ -321,6 +321,16 @@ class _Slot:
     # matches the occurrence it is extending.
     bigram: dict = field(default_factory=dict)
     bigram_next: int = 0
+    # per-request device-cost accounting (ISSUE 15, cost_registry on):
+    # the scheduler round this slot admitted at, the prompt offset
+    # prefill started from (cache-hit positions never compute), the
+    # prompt tokens actually prefilled on device, and the draft tokens
+    # spec-decode booked for this request — the retire event's cost
+    # record is assembled from exactly these host counters
+    admit_round: int = 0
+    prefill_start: int = 0
+    prefilled: int = 0
+    spec_accepted: int = 0
 
     @property
     def prefilling(self) -> bool:
@@ -794,6 +804,38 @@ class DecodeEngine:
       the event ring bound. Telemetry never touches jitted code —
       telemetry-on steps are bitwise telemetry-off
       (docs/GUIDE.md "Observability").
+    - `cost_registry` (default False, ISSUE 15): capture each minted
+      executable's compiled cost (cost_analysis FLOPs/bytes +
+      memory_analysis temp/args bytes) at MINT time into a
+      telemetry/costs.CostRegistry — never in the per-round path.
+      Unlocks the per-request device-cost record stamped into retire
+      events (prefill/decode/spec-accepted tokens, page-rounds held,
+      modeled FLOPs), the `serve_modeled_gflops`/`serve_page_rounds`
+      aggregates, and (with a known chip) the
+      `serve_dispatch_overhead_pct` gauge — modeled roofline device
+      time vs measured round wall. Opt-in because capture pays one
+      extra AOT compile per minted executable (docs/GUIDE.md "Goodput
+      & device-cost accounting"); all gauges it adds are absent when
+      off, keeping the /metrics JSON byte-compatible.
+    - `chip_spec` (default None = detect from the engine's devices):
+      chipspec table override ("v5e"/"v5p"/"v4") for the roofline
+      denominators — the only way to get deterministic overhead
+      gauges on the CPU harness.
+    - `perf_sentinel_ksigma` (default 0.0 = off, ISSUE 15): arm the
+      perf-regression sentinel on the DECODE-SCAN per-token-advance
+      round latency — the one homogeneous series. Mixed rounds are
+      excluded (their wall carries a prefill chunk: long-prompt
+      admission would read as a false regression) and so are spec
+      rounds (their per-advance moves with the ACCEPT RATE: a prompt
+      mix dropping acceptance is not a hardware regression);
+      interference and acceptance stay the serve_decode_round_ms
+      histogram's and serve_spec_accept_rate's jobs. `patience`
+      consecutive rounds above median + ksigma * 1.4826*MAD of the
+      recent window trips it — flight-recorder event trail, a
+      `serve_perf_regressions` counter, and an auto-dump of the ring
+      into record_dir through the same postmortem path as poison.
+      `perf_sentinel_window`/`perf_sentinel_patience` tune it
+      (docs/GUIDE.md sentinel tuning table).
 
     Pages are reserved UP FRONT at admission for the request's whole
     prompt + tokens_to_generate reach, so a running request can never
@@ -818,7 +860,12 @@ class DecodeEngine:
                  vocab_size: Optional[int] = None, timers=None,
                  trace_dir: Optional[str] = None,
                  record_dir: Optional[str] = None,
-                 flight_recorder_size: int = 4096):
+                 flight_recorder_size: int = 4096,
+                 cost_registry: bool = False,
+                 chip_spec: Optional[str] = None,
+                 perf_sentinel_ksigma: float = 0.0,
+                 perf_sentinel_window: int = 64,
+                 perf_sentinel_patience: int = 8):
         assert max_context % page_size == 0, \
             "max_context must be a multiple of page_size"
         if kv_dtype not in ("bf16", "int8"):
@@ -978,6 +1025,55 @@ class DecodeEngine:
         self._running = False
         self._broken: Optional[str] = None
 
+        # -- compiled-cost registry + perf sentinel (ISSUE 15) ------------
+        # Construction precedes the _copy_fn mint below so the first
+        # executable this engine ever mints is already capturable.
+        self.costs = None
+        self.chip = None
+        if cost_registry:
+            from megatron_llm_tpu.telemetry.chipspec import detect_chip
+            from megatron_llm_tpu.telemetry.costs import CostRegistry
+
+            self.chip = detect_chip(
+                devices=self._ctx.mesh.devices.flatten().tolist()
+                if self._ctx is not None else None,
+                override=chip_spec)
+            # owner=self: the mint-listener inventory tracks THIS
+            # engine's variants, not a sibling replica's
+            self.costs = CostRegistry(chip=self.chip, owner=self).attach()
+        # analytic per-token decode-FLOPs coefficients for the
+        # per-request cost record (telemetry/chipspec.py model):
+        # linear term 2*N over the decode tree, attention term
+        # 4*L*h per cached position
+        self._cost_fpt_linear = 0.0
+        self._cost_attn_coeff = 0.0
+        if self.costs is not None:
+            n_dec = sum(
+                int(np.prod(l.shape)) for l in jax.tree.leaves(dec)
+                if hasattr(l, "shape"))
+            self._cost_fpt_linear = 2.0 * n_dec
+            self._cost_attn_coeff = (4.0 * self.cfg.num_layers
+                                     * self.cfg.hidden_size)
+        # modeled-vs-measured dispatch accounting (round granularity)
+        self._modeled_device_ms = 0.0
+        self._measured_round_ms = 0.0
+        self._modeled_gflops = 0.0
+        self._page_rounds = 0
+        self._sentinel = None
+        if perf_sentinel_ksigma > 0:
+            from megatron_llm_tpu.telemetry.sentinel import PerfSentinel
+
+            self._sentinel = PerfSentinel(
+                k_sigma=perf_sentinel_ksigma,
+                # clamped like the trainer's (arguments.py path): a
+                # too-small CLI value degrades to the floor instead of
+                # an unexplained AssertionError at server startup
+                window=max(perf_sentinel_window, 4),
+                patience=max(perf_sentinel_patience, 1),
+                recorder=None,  # wired to self.recorder below (the
+                # recorder is constructed in the telemetry block)
+                name="decode_round_ms")
+
         self._step_fns: dict = {}  # horizon bucket -> jitted scan
         self._mixed_fns: dict = {}  # (width bucket, greedy) -> jitted
         # spec verification executables: ONE width (spec_decode_k + 1)
@@ -987,6 +1083,8 @@ class DecodeEngine:
         self._spec_fns: dict = {}  # (width, greedy) -> jitted
         self._copy_fn = _make_page_copy_fn(
             contract_key=(), contract_owner=self, contract_budget=1)
+        self._capture_cost("engine.page_copy", (), self._copy_fn,
+                           self._null_copy_args)
         # whole-prompt prefill executables, LRU-bounded like the pp
         # decode cache (api.py _pp_decode_fn): prompt buckets are an
         # unbounded key space across traffic
@@ -1044,6 +1142,10 @@ class DecodeEngine:
         self.recorder = FlightRecorder(
             flight_recorder_size,
             base=None if replica_id is None else {"replica": replica_id})
+        if self._sentinel is not None:
+            # the sentinel's bad/trip event trail lands in the same
+            # flight ring its trip auto-dumps (ISSUE 15)
+            self._sentinel.recorder = self.recorder
         self._hists = {
             "serve_ttft_ms": Histogram(
                 "serve_ttft_ms", help_text="submit -> first generated "
@@ -1081,6 +1183,21 @@ class DecodeEngine:
         if self._ctx is None:
             return jnp.asarray(x)
         return jax.device_put(np.asarray(x), self._rep)
+
+    def _capture_cost(self, name: str, key, fn, args_thunk) -> None:
+        """Compiled-cost capture for one freshly MINTED executable
+        (ISSUE 15): lowers `fn` against warmup-style example args (the
+        thunk defers building them — and any device_put they need —
+        until the registry is actually on) and records cost_analysis
+        FLOPs/bytes + memory_analysis temp/args under (contract, key).
+        Mint-time only by construction: every call site sits next to a
+        builder invocation, never in the per-round path (the GR006
+        contract); the capture itself pays one extra AOT compile per
+        executable, which is why cost_registry is opt-in."""
+        if self.costs is None:
+            return
+        with self.mesh_scope():
+            self.costs.capture(name, key, fn, args_thunk())
 
     def _artifact_tag(self, base: str) -> str:
         """Filename tag for exported artifacts (span traces, flight-
@@ -1254,6 +1371,8 @@ class DecodeEngine:
                               contract_key=plen, contract_owner=self,
                               contract_budget=self._PREFILL_CACHE_CAP)
         self._prefill_fns[plen] = fn
+        self._capture_cost("engine.prefill_bucket", plen, fn,
+                           lambda: self._null_prefill_args(plen))
         return fn
 
     def _admit(self) -> int:
@@ -1320,6 +1439,12 @@ class DecodeEngine:
             slot.registered = match.full_pages if match is not None else 0
             slot.bigram = {}
             slot.bigram_next = 0
+            # per-request cost accounting (ISSUE 15): admission round,
+            # prefill origin, and counters the retire record reads
+            slot.admit_round = self._rounds
+            slot.prefill_start = 0
+            slot.prefilled = 0
+            slot.spec_accepted = 0
             req.tokens = list(req.prompt)
             if self.prefill_chunk_tokens:
                 # chunked admission: no device work here beyond the COW
@@ -1353,6 +1478,7 @@ class DecodeEngine:
                 if self._prefix is not None:
                     self._prefix.note(len(req.prompt), matched)
                 slot.prefill_pos = matched
+                slot.prefill_start = matched
                 slot.forced = collections.deque()
                 self._lengths[si] = matched
             else:
@@ -1373,6 +1499,7 @@ class DecodeEngine:
                 self._lengths[si] = plen
                 slot.prefill_pos = len(req.prompt)
                 slot.forced = collections.deque(req.prompt[plen:])
+                slot.prefilled = plen
                 self._prefill_tokens += plen
                 prefilled += plen
                 if req.return_log_probs:
@@ -1392,8 +1519,50 @@ class DecodeEngine:
             self._admitted += 1
         return prefilled
 
+    def _request_cost(self, si: int) -> Optional[dict]:
+        """The per-request device-cost record stamped into the retire
+        event (ISSUE 15; cost_registry on). GR006 HOT_PATHS: pure host
+        arithmetic over the slot's own counters and the host-side
+        length mirror — never a device value. modeled_mflops is the
+        analytic decode model (telemetry/chipspec.decode_flops_per_token
+        coefficients precomputed at construction): the linear term over
+        every position this request computed past its cache-hit offset,
+        plus the attention integral over its context growth. A MODELED
+        number by contract — it prices the request for cost-per-token
+        attribution (the Gemma fine-tune-and-serve framing), it is not
+        a profiler measurement."""
+        if self.costs is None:
+            return None
+        slot = self._slots[si]
+        req = slot.req
+        final_len = int(self._lengths[si])
+        start = slot.prefill_start
+        computed = max(final_len - start, 0)
+        rounds_held = self._rounds - slot.admit_round + 1
+        pages = len(slot.pages)
+        modeled = (self._cost_fpt_linear * computed
+                   + 0.5 * self._cost_attn_coeff
+                   * (final_len * final_len - start * start))
+        return {
+            "prompt_tokens": len(req.prompt),
+            "cached_tokens": start,
+            "prefill_tokens": slot.prefilled,
+            "decode_tokens": slot.generated,
+            "spec_accepted": slot.spec_accepted,
+            "rounds_held": rounds_held,
+            "pages": pages,
+            "page_rounds": pages * rounds_held,
+            "modeled_mflops": round(modeled / 1e6, 3),
+        }
+
     def _retire(self, si: int):
         slot = self._slots[si]
+        # cost record FIRST: it reads pages/lengths/counters this
+        # method is about to reset
+        cost = self._request_cost(si)
+        if cost is not None:
+            self._modeled_gflops += cost["modeled_mflops"] / 1e3
+            self._page_rounds += cost["page_rounds"]
         if self._prefix is None:
             self._free_pages.extend(slot.pages)
         else:
@@ -1415,8 +1584,12 @@ class DecodeEngine:
         self.tracer.instant("retire", rid=req.rid, slot=si,
                             generated=slot.generated,
                             error=req.error is not None)
+        # the retire event schema grows the cost record ONLY when the
+        # registry is on (the pre-ISSUE-15 event stays byte-identical)
         self.recorder.record("retire", rid=req.rid, slot=si,
-                             generated=slot.generated, error=req.error)
+                             generated=slot.generated, error=req.error,
+                             **({"cost": cost} if cost is not None
+                                else {}))
         self._finish(req)
 
     # -- the decode loop ---------------------------------------------------
@@ -1431,6 +1604,9 @@ class DecodeEngine:
                 self.model, self.vocab_size, horizon, all_greedy,
                 contract_key=key, contract_owner=self,
                 contract_budget=2 * len(horizon_buckets(self.step_horizon)))
+            self._capture_cost(
+                "engine.decode_scan", key, self._step_fns[key],
+                lambda: self._null_scan_args(horizon))
         return self._step_fns[key]
 
     def _mixed_fn(self, width, all_greedy):
@@ -1441,6 +1617,9 @@ class DecodeEngine:
                 contract_key=key, contract_owner=self,
                 contract_budget=2 * len(
                     mixed_width_buckets(self.prefill_chunk_tokens)))
+            self._capture_cost(
+                "engine.mixed_step", key, self._mixed_fns[key],
+                lambda: self._null_mixed_args(width))
         return self._mixed_fns[key]
 
     def _chunk_width(self, remaining: int) -> int:
@@ -1645,7 +1824,8 @@ class DecodeEngine:
                 prefilled_tokens=admit_prefilled)
         if self.prefill_chunk_tokens and any(
                 s.prefilling for s in self._slots):
-            dec_steps, pf_tokens, chunk_rid = self._mixed_round()
+            dec_steps, pf_tokens, chunk_rid, mixed_key = \
+                self._mixed_round()
             t1 = time.perf_counter()
             dt_ms = (t1 - t0) * 1e3
             with self._lock:  # counters() reads these windows concurrently
@@ -1656,6 +1836,14 @@ class DecodeEngine:
                     self._decode_ms.append(dt_ms)
             if dec_steps:
                 self._hists["serve_decode_round_ms"].observe(dt_ms)
+            # the sentinel deliberately does NOT eat mixed rounds:
+            # their wall includes a prefill chunk, so a long-prompt
+            # admission would look like `patience` consecutive
+            # "regressions" against the per-token-advance baseline the
+            # decode/spec rounds feed — interference is the
+            # serve_decode_round_ms HISTOGRAM's job (bounded by
+            # design), a sustained decode slowdown is the sentinel's
+            self._note_dispatch("engine.mixed_step", mixed_key, dt_ms)
             # chunk-prefill span: rid-correlated — a streaming client's
             # stalled `id:` greps straight to these rounds
             self.tracer.complete(
@@ -1673,6 +1861,44 @@ class DecodeEngine:
                 self._spec_round(drafts, t0, admit_prefilled)
                 return True
         return self._decode_round(t0, admit_prefilled)
+
+    def _note_dispatch(self, name: str, key, dt_ms: float) -> None:
+        """Round-granularity modeled-vs-measured accounting behind the
+        serve_dispatch_overhead_pct gauge (ISSUE 15): the registry's
+        roofline device time for the executable this round dispatched
+        vs the round's measured wall. GR006 HOT_PATHS: one dict lookup
+        + float adds; rounds whose executable has no captured record
+        (or no known chip) contribute measurement only and the gauge
+        stays honest about its modeled denominator."""
+        if self.costs is None:
+            return
+        self._measured_round_ms += dt_ms
+        rec = self.costs.record(name, key)
+        if rec is None:
+            return
+        modeled = rec.modeled_seconds(self.chip, n_chips=self.serving_tp)
+        if modeled is not None:
+            self._modeled_device_ms += modeled * 1e3
+
+    def _sentinel_observe(self, ms_per_advance: float) -> None:
+        """Feed the perf sentinel one DECODE-SCAN round's per-token-
+        advance latency — the one homogeneous series (mixed and spec
+        rounds are excluded at their call sites: prefill interference
+        and accept-rate drift are not hardware regressions); a TRIP
+        auto-dumps the flight ring through the same postmortem path as
+        poison. GR006 HOT_PATHS: host floats; the dump runs only on
+        the (rare) trip."""
+        if self._sentinel is None:
+            return
+        if self._sentinel.observe(ms_per_advance, step=self._rounds):
+            self.recorder.note_counters(self.counters())
+            self.recorder.dump(
+                self.record_dir,
+                self._artifact_tag("perf-regression"),
+                extra={"trip": self._sentinel.trips,
+                       "threshold_ms": round(
+                           self._sentinel.last_threshold, 3),
+                       "round": self._rounds})
 
     def _decode_round(self, t0: float, prefill_tokens: int = 0) -> bool:
         """One jitted scan of up to `step_horizon` decode steps over
@@ -1767,6 +1993,9 @@ class DecodeEngine:
             # this round's wall time — that IS the interference)
             self._decode_ms.append(dt_ms / hor)
         self._hists["serve_decode_round_ms"].observe(dt_ms / hor)
+        self._note_dispatch("engine.decode_scan", (hor, all_greedy),
+                            dt_ms)
+        self._sentinel_observe(dt_ms / hor)
         self.tracer.complete("round.decode_scan", t0, t1,
                              round=self._rounds, horizon=hor,
                              decode_slots=len(live),
@@ -1786,7 +2015,8 @@ class DecodeEngine:
         idle (chunk_lens 0). One jitted dispatch serves all of it.
         Returns (decode slots advanced, prefill tokens consumed, the
         chunk request's rid — the round's trace-span correlation
-        key)."""
+        key — and the (width, greedy) executable key the round's
+        dispatch-overhead accounting reads)."""
         n = self.slots
         pref = [i for i, s in enumerate(self._slots) if s.prefilling]
         ci = min(pref, key=lambda i: self._slots[i].req.rid)
@@ -1860,6 +2090,7 @@ class DecodeEngine:
                 r.log_probs.extend(
                     float(x) for x in chunk_lps[:ln - 1])
         s_c.prefill_pos += ln
+        s_c.prefilled += ln
         self._lengths[ci] += ln
         # every prompt page this chunk completed becomes a shareable
         # cache entry (no-op without the prefix cache)
@@ -1874,7 +2105,7 @@ class DecodeEngine:
             if r.return_log_probs:
                 r.log_probs.append(float(first_lp[i]))
             self._book_token(i, int(first[i]), now)
-        return len(dec), ln, s_c.req.rid
+        return len(dec), ln, s_c.req.rid, (width, all_greedy)
 
     # -- prefix sharing ----------------------------------------------------
 
@@ -1908,6 +2139,9 @@ class DecodeEngine:
                 self.model, self.vocab_size, width, all_greedy,
                 contract_key=key, contract_owner=self,
                 contract_budget=2)
+            self._capture_cost(
+                "engine.spec_verify", key, self._spec_fns[key],
+                lambda: self._null_spec_args(width))
         return self._spec_fns[key]
 
     def _draft(self, si: int) -> List[int]:
@@ -2045,7 +2279,8 @@ class DecodeEngine:
         now = time.perf_counter()
         emitted_total = 0
         for i in live:
-            r = self._slots[i].req
+            s = self._slots[i]
+            r = s.req
             d_n = int(chunk_lens[i]) - 1
             a = int(acc[i]) if d_n else 0
             self._spec_proposed += d_n
@@ -2059,10 +2294,15 @@ class DecodeEngine:
                       float(gt_lp[i, j]) if want_lp else 0.0)
                      for j in range(a)]
             booked = 0
-            for tok, lp in emit:
+            for j, (tok, lp) in enumerate(emit):
                 self._lengths[i] += 1
                 if r.return_log_probs:
                     r.log_probs.append(lp)
+                if j > 0:
+                    # per-request spec accounting for the retire cost
+                    # record: BEFORE _book_token, which may retire the
+                    # slot (resetting its counters) on eod/budget
+                    s.spec_accepted += 1
                 booked += 1
                 if self._book_token(i, tok, now):
                     break  # eod/budget: stale chunk tail never books
@@ -2089,6 +2329,15 @@ class DecodeEngine:
             # emitted/live tokens per slot
             self._decode_ms.append(per_advance)
         self._hists["serve_decode_round_ms"].observe(per_advance)
+        self._note_dispatch("engine.spec_verify", (width, all_greedy),
+                            dt_ms)
+        # NOT fed to the sentinel (same reasoning as mixed rounds): a
+        # spec round's per-advance latency moves with the ACCEPT RATE
+        # — adversarial prompts dropping acceptance would read as a
+        # hardware regression against a decode-scan baseline. The
+        # sentinel watches the one homogeneous series (decode-scan
+        # per-token-advance); acceptance drift is serve_spec_accept_
+        # rate's job.
         self.tracer.complete("round.spec_verify", t0, t1,
                              round=self._rounds, decode_slots=len(live),
                              emitted=emitted_total,
@@ -2136,6 +2385,76 @@ class DecodeEngine:
                 s.req.error = msg
                 self._retire(i)
 
+    # -- idle-round example args (ONE construction for warmup, the AOT
+    # audit, and mint-time cost capture — three consumers of the same
+    # shapes that previously each hand-built them, ISSUE 15 refactor).
+    # All-zero page-table rows route every K/V write to the dead null
+    # page; the live pools ride the args so what is traced/lowered is
+    # exactly what traffic runs.
+
+    def _null_scan_args(self, h: int) -> tuple:
+        n = self.slots
+        zeros_i = self._dev(np.zeros((n,), np.int32))
+        return (self._dec_params, self._pools_k, self._pools_v,
+                self._pools_ks, self._pools_vs,
+                self._dev(np.zeros_like(self._pt)), zeros_i,
+                self._last_logits,
+                self._dev(np.zeros(n, bool)),
+                self._dev(np.zeros((n, h), np.int32)),
+                self._dev(np.zeros((n, h), bool)),
+                self._dev(np.ones(n, bool)),
+                self._dev(np.ones(n, np.float32)),
+                zeros_i,
+                self._dev(np.zeros(n, np.float32)),
+                self._dev(np.zeros(n, np.uint32)),
+                zeros_i)
+
+    def _null_mixed_args(self, w: int) -> tuple:
+        n = self.slots
+        zeros_i = self._dev(np.zeros((n,), np.int32))
+        return (self._dec_params, self._pools_k, self._pools_v,
+                self._pools_ks, self._pools_vs,
+                self._dev(np.zeros_like(self._pt)), zeros_i,
+                self._last_logits,
+                self._dev(np.zeros((n, w), np.int32)),
+                zeros_i,
+                self._dev(np.zeros(n, bool)),
+                self._dev(0, np.int32),
+                self._dev(np.ones(n, bool)),
+                self._dev(np.ones(n, np.float32)),
+                zeros_i,
+                self._dev(np.zeros(n, np.float32)),
+                self._dev(np.zeros(n, np.uint32)),
+                zeros_i)
+
+    def _null_spec_args(self, w: int) -> tuple:
+        n = self.slots
+        zeros_i = self._dev(np.zeros((n,), np.int32))
+        return (self._dec_params, self._pools_k, self._pools_v,
+                self._pools_ks, self._pools_vs,
+                self._dev(np.zeros_like(self._pt)), zeros_i,
+                self._last_logits,
+                self._dev(np.zeros((n, w), np.int32)),
+                zeros_i,
+                self._dev(np.zeros(n, bool)),
+                self._dev(np.ones(n, bool)),
+                self._dev(np.ones(n, np.float32)),
+                zeros_i,
+                self._dev(np.zeros(n, np.float32)),
+                self._dev(np.zeros(n, np.uint32)),
+                zeros_i)
+
+    def _null_prefill_args(self, plen: int) -> tuple:
+        return (self._dec_params, self._pools_k, self._pools_v,
+                self._pools_ks, self._pools_vs,
+                self._dev(np.zeros((1, plen), np.int32)),
+                self._dev(self._pt[0]))
+
+    def _null_copy_args(self) -> tuple:
+        return (self._pools_k, self._pools_v, self._pools_ks,
+                self._pools_vs, self._dev(0, np.int32),
+                self._dev(0, np.int32))
+
     def warmup(self):
         """Pre-trace every step executable the configured buckets can
         reach — the pow2 decode-scan horizons and (chunked mode) the
@@ -2150,126 +2469,51 @@ class DecodeEngine:
             self._warmup_scoped()
 
     def _warmup_scoped(self):
-        n = self.slots
-        zeros_i = self._dev(np.zeros((n,), np.int32))
-        null_pt = self._dev(np.zeros_like(self._pt))
         for h in horizon_buckets(self.step_horizon):
             (_, _, _, self._pools_k, self._pools_v, self._pools_ks,
-             self._pools_vs) = self._step_fn(
-                h, True)(
-                self._dec_params, self._pools_k, self._pools_v,
-                self._pools_ks, self._pools_vs,
-                null_pt, zeros_i, self._last_logits,
-                self._dev(np.zeros(n, bool)),
-                self._dev(np.zeros((n, h), np.int32)),
-                self._dev(np.zeros((n, h), bool)),
-                self._dev(np.ones(n, bool)),
-                self._dev(np.ones(n, np.float32)),
-                zeros_i,
-                self._dev(np.zeros(n, np.float32)),
-                self._dev(np.zeros(n, np.uint32)),
-                zeros_i,
-            )
+             self._pools_vs) = self._step_fn(h, True)(
+                *self._null_scan_args(h))
         if self.prefill_chunk_tokens:
             for w in mixed_width_buckets(self.prefill_chunk_tokens):
                 (_, _, _, _, self._pools_k, self._pools_v,
                  self._pools_ks, self._pools_vs) = \
-                    self._mixed_fn(w, True)(
-                    self._dec_params, self._pools_k, self._pools_v,
-                    self._pools_ks, self._pools_vs,
-                    null_pt, zeros_i, self._last_logits,
-                    self._dev(np.zeros((n, w), np.int32)),
-                    zeros_i,
-                    self._dev(np.zeros(n, bool)),
-                    self._dev(0, np.int32),
-                    self._dev(np.ones(n, bool)),
-                    self._dev(np.ones(n, np.float32)),
-                    zeros_i,
-                    self._dev(np.zeros(n, np.float32)),
-                    self._dev(np.zeros(n, np.uint32)),
-                    zeros_i,
-                )
+                    self._mixed_fn(w, True)(*self._null_mixed_args(w))
         if self.spec_decode_k:
             w = self.spec_decode_k + 1
             (_, _, _, _, _, _, self._pools_k, self._pools_v,
              self._pools_ks, self._pools_vs) = \
-                self._spec_fn(w, True)(
-                self._dec_params, self._pools_k, self._pools_v,
-                self._pools_ks, self._pools_vs,
-                null_pt, zeros_i, self._last_logits,
-                self._dev(np.zeros((n, w), np.int32)),
-                zeros_i,
-                self._dev(np.zeros(n, bool)),
-                self._dev(np.ones(n, bool)),
-                self._dev(np.ones(n, np.float32)),
-                zeros_i,
-                self._dev(np.zeros(n, np.float32)),
-                self._dev(np.zeros(n, np.uint32)),
-                zeros_i,
-            )
+                self._spec_fn(w, True)(*self._null_spec_args(w))
 
     def audit_entry_points(self):
         """(contract name, jitted fn, example args) for every jitted
         entry point this engine's configuration can dispatch — the AOT
         compile-contract audit (analysis/audit.py) lowers each one
         against the REAL pools/params, so what it audits is exactly
-        what traffic runs. Args mirror warmup()'s idle-round
-        construction (null page table, zero lengths); nothing here
-        executes — builders are invoked (minting variants within the
-        engine's own budgets) but the returned fns are only lowered.
+        what traffic runs. Args are the same idle-round construction
+        warmup() and mint-time cost capture use (the _null_*_args
+        helpers); nothing here executes — builders are invoked (minting
+        variants within the engine's own budgets) but the returned fns
+        are only lowered.
 
         On a tp mesh the caller must ALSO lower under `mesh_scope()`
         (analysis/audit.py does): the constraints bake at trace time,
         and the tp2 audit rows exist to pin exactly that program."""
-        n = self.slots
-        zeros_i = self._dev(np.zeros((n,), np.int32))
-        null_pt = self._dev(np.zeros_like(self._pt))
-        zeros_b = self._dev(np.zeros(n, bool))
-        ones_b = self._dev(np.ones(n, bool))
-        ones_f = self._dev(np.ones(n, np.float32))
-        zeros_f = self._dev(np.zeros(n, np.float32))
-        zeros_u = self._dev(np.zeros(n, np.uint32))
         h = horizon_buckets(self.step_horizon)[-1]
-        out = [(
-            "engine.decode_scan", self._step_fn(h, True),
-            (self._dec_params, self._pools_k, self._pools_v,
-             self._pools_ks, self._pools_vs, null_pt,
-             zeros_i, self._last_logits, zeros_b,
-             self._dev(np.zeros((n, h), np.int32)),
-             self._dev(np.zeros((n, h), bool)), ones_b, ones_f,
-             zeros_i, zeros_f, zeros_u, zeros_i))]
+        out = [("engine.decode_scan", self._step_fn(h, True),
+                self._null_scan_args(h))]
         if self.prefill_chunk_tokens:
             w = mixed_width_buckets(self.prefill_chunk_tokens)[-1]
-            out.append((
-                "engine.mixed_step", self._mixed_fn(w, True),
-                (self._dec_params, self._pools_k, self._pools_v,
-                 self._pools_ks, self._pools_vs, null_pt,
-                 zeros_i, self._last_logits,
-                 self._dev(np.zeros((n, w), np.int32)), zeros_i,
-                 zeros_b, self._dev(0, np.int32), ones_b, ones_f,
-                 zeros_i, zeros_f, zeros_u, zeros_i)))
+            out.append(("engine.mixed_step", self._mixed_fn(w, True),
+                        self._null_mixed_args(w)))
         plen = bucket_prefill_len(min(8, self.max_context))
-        out.append((
-            "engine.prefill_bucket", self._prefill_fn(plen),
-            (self._dec_params, self._pools_k, self._pools_v,
-             self._pools_ks, self._pools_vs,
-             self._dev(np.zeros((1, plen), np.int32)),
-             self._dev(self._pt[0]))))
+        out.append(("engine.prefill_bucket", self._prefill_fn(plen),
+                    self._null_prefill_args(plen)))
         if self.spec_decode_k:
             w = self.spec_decode_k + 1
-            out.append((
-                "engine.spec_verify", self._spec_fn(w, True),
-                (self._dec_params, self._pools_k, self._pools_v,
-                 self._pools_ks, self._pools_vs, null_pt,
-                 zeros_i, self._last_logits,
-                 self._dev(np.zeros((n, w), np.int32)), zeros_i,
-                 zeros_b, ones_b, ones_f, zeros_i, zeros_f, zeros_u,
-                 zeros_i)))
-        out.append((
-            "engine.page_copy", self._copy_fn,
-            (self._pools_k, self._pools_v, self._pools_ks,
-             self._pools_vs, self._dev(0, np.int32),
-             self._dev(0, np.int32))))
+            out.append(("engine.spec_verify", self._spec_fn(w, True),
+                        self._null_spec_args(w)))
+        out.append(("engine.page_copy", self._copy_fn,
+                    self._null_copy_args()))
         return out
 
     def start(self):
@@ -2323,8 +2567,11 @@ class DecodeEngine:
                         live_rids=[s.req.rid for s in self._slots
                                    if s.req is not None])
                     self.recorder.note_counters(self.counters())
-                    self.recorder.dump(self.record_dir,
-                                       self._artifact_tag("engine-poison"))
+                    self.recorder.dump(
+                        self.record_dir,
+                        self._artifact_tag("engine-poison"),
+                        extra={"costs": self.costs.snapshot()}
+                        if self.costs is not None else None)
                     self._stop_profile()
                     self._fail_all(self._broken)
                     self._running = False
@@ -2490,6 +2737,26 @@ class DecodeEngine:
             out["serve_spec_accepted"] = self._spec_accepted
             out["serve_spec_accept_rate"] = round(
                 self._spec_accepted / max(self._spec_proposed, 1), 4)
+        if self.costs is not None:
+            # device-cost gauges (ISSUE 15; ABSENT when the registry is
+            # off so the legacy JSON schema stays byte-compatible):
+            # aggregated per-request modeled work + pool occupancy-time,
+            # and — when the chip is known — modeled roofline device
+            # time vs measured round wall (the dispatch-overhead gauge)
+            out["serve_modeled_gflops"] = round(self._modeled_gflops, 3)
+            out["serve_page_rounds"] = self._page_rounds
+            out["serve_cost_records"] = self.costs.captures
+            if self.chip is not None:
+                out["serve_chip_spec"] = self.chip.label()
+            if self._modeled_device_ms > 0 and self._measured_round_ms > 0:
+                out["serve_dispatch_overhead_pct"] = round(
+                    (self._measured_round_ms - self._modeled_device_ms)
+                    / self._measured_round_ms * 100, 2)
+        if self._sentinel is not None:
+            # gated like the cost gauges: the sentinel-off schema is
+            # the legacy one
+            out["serve_perf_regressions"] = self._sentinel.trips
+            out["serve_perf_bad_rounds"] = self._sentinel.bad_total
         return out
 
     def export_gauges(self, timers=None):
@@ -2509,13 +2776,24 @@ class DecodeEngine:
     def prometheus_metrics(self) -> str:
         """The Prometheus text exposition GET /metrics serves under
         content negotiation: every numeric counter as a gauge, string
-        facts as one info metric, plus the real histograms. The JSON
+        facts as one info metric, plus the real histograms — and, with
+        the cost registry on, the per-(contract, specialization)
+        compiled-cost gauges as labeled samples (ISSUE 15). The JSON
         path (counters()) stays byte-compatible and untouched."""
-        return render_prometheus(self.counters(), self.histograms())
+        text = render_prometheus(self.counters(), self.histograms())
+        if self.costs is not None:
+            lines = self.costs.prometheus_lines()
+            if lines:
+                text += "\n".join(lines) + "\n"
+        return text
 
     def flight_record(self) -> dict:
         """On-demand flight-recorder snapshot (GET /flight_record):
-        the same artifact a dying engine dumps, with live counters
+        the same artifact a dying engine dumps, with live counters —
+        and, with the cost registry on, the full compiled-cost table —
         attached."""
         self.recorder.note_counters(self.counters())
-        return self.recorder.snapshot(reason="on-demand")
+        return self.recorder.snapshot(
+            reason="on-demand",
+            extra={"costs": self.costs.snapshot()}
+            if self.costs is not None else None)

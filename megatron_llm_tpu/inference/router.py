@@ -54,11 +54,13 @@ max_context/page_size/num_pages admission limits), so
 handler code that serves one engine. Aggregation rules: additive
 counters sum (`serve_kv_pool_bytes_fleet` scales each replica's
 per-chip gauge by its tp), latency histograms merge by bucket (they
-are cumulative by design — telemetry/prometheus.Histogram.merged;
-IN-PROCESS replicas only — remote replicas' bucket data is not on the
-JSON probe surface, scrape them directly), per-replica detail rides
-under `"replicas"`, and `router_*` counters expose the dispatch
-decisions themselves.
+are cumulative by design — telemetry/prometheus.Histogram.merged) —
+remote replicas' distributions included: HTTPReplica scrapes each
+remote's Prometheus /metrics text and rebuilds its histograms via
+`Histogram.from_cumulative` (ISSUE 15, closing the PR-14 gap where
+the merged view covered in-process replicas only) — per-replica
+detail rides under `"replicas"`, and `router_*` counters expose the
+dispatch decisions themselves.
 
 `EngineReplica` wraps an in-process engine (tests, bench emulation,
 the `--router_replicas` serving tool); `HTTPReplica` speaks the same
@@ -262,10 +264,14 @@ class HTTPReplica:
     (GET /health, GET /metrics, PUT /api). Generation submits ride a
     background thread per request so the router's submit stays
     non-blocking like the in-process form; the returned handle exposes
-    the same `result(timeout)` contract as EngineRequest. Token
-    streaming, cancel, and latency histograms are not proxied — front
-    a remote fleet's streaming traffic at the replica, scrape each
-    replica's own /metrics for its distributions, or run the router
+    the same `result(timeout)` contract as EngineRequest. Latency
+    histograms ARE proxied (ISSUE 15): the probe also scrapes the
+    replica's Prometheus text exposition (`/metrics?format=prometheus`)
+    and rebuilds its cumulative histograms
+    (telemetry/prometheus.histograms_from_prometheus), so the router's
+    merged fleet distributions cover remote replicas too. Token
+    streaming and cancel are still not proxied — front a remote
+    fleet's streaming traffic at the replica, or run the router
     in-process with the engines (EngineReplica)."""
 
     def __init__(self, replica_id: int, base_url: str,
@@ -281,14 +287,25 @@ class HTTPReplica:
         self.max_context = max_context
         self.num_pages = (max_context * 64) // page_size  # advisory
         self._probe: Tuple[float, dict] = (0.0, {})
+        # histogram scrape cached SEPARATELY from the health/load
+        # probe: the probe feeds the ROUTING path (submit-time
+        # health/load), which must never wait on the Prometheus text
+        # fetch only the fleet /metrics aggregation consumes
+        self._hist_probe: Tuple[float, list] = (0.0, [])
+
+    def _get_raw(self, path: str, accept: Optional[str] = None) -> bytes:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base_url + path,
+            headers={"Accept": accept} if accept else {})
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            return resp.read()
 
     def _get_json(self, path: str) -> dict:
         import json
-        import urllib.request
 
-        with urllib.request.urlopen(self.base_url + path,
-                                    timeout=5.0) as resp:
-            return json.loads(resp.read().decode())
+        return json.loads(self._get_raw(path).decode())
 
     def _probed(self) -> dict:
         now = time.monotonic()
@@ -308,6 +325,35 @@ class HTTPReplica:
                     "metrics": {}}
         self._probe = (now, snap)
         return snap
+
+    def _scrape_histograms(self) -> list:
+        """The remote's latency distributions, rebuilt from its
+        Prometheus text exposition, under its own TTL cache — lazy:
+        only the fleet /metrics aggregation path (histograms()) pays
+        this fetch, never a routing-time health/load probe. Failures
+        degrade to [] — a replica on a pre-Prometheus build (or
+        mid-restart) drops out of the merged distributions rather than
+        failing the fleet scrape; its health/liveness probing is
+        unaffected."""
+        from megatron_llm_tpu.telemetry import histograms_from_prometheus
+
+        now = time.monotonic()
+        t, cached = self._hist_probe
+        if now - t < self.probe_ttl_s:
+            return cached
+        try:
+            text = self._get_raw("/metrics?format=prometheus",
+                                 accept="text/plain").decode()
+            hs = histograms_from_prometheus(text)
+        except Exception as e:  # noqa: BLE001
+            _logger.warning(
+                "HTTPReplica %d: Prometheus histogram scrape failed "
+                "(%r) — this replica's distributions are missing from "
+                "the merged fleet /metrics this probe window",
+                self.replica_id, e)
+            hs = []
+        self._hist_probe = (now, hs)
+        return hs
 
     def health(self) -> dict:
         h = self._probed()["health"]
@@ -334,11 +380,11 @@ class HTTPReplica:
         return int(self.counters().get("serve_kv_pool_bytes", 0))
 
     def histograms(self):
-        # NOT proxied: the JSON /metrics surface carries no bucket
-        # data, so the router's MERGED latency distributions cover
-        # in-process replicas only — scrape each remote replica's own
-        # /metrics (Prometheus form) for its histograms
-        return []
+        """The remote's histograms, scraped from its Prometheus
+        exposition on demand (rebuilt cumulative-bucket form —
+        mergeable with the in-process replicas' via
+        Histogram.merged)."""
+        return list(self._scrape_histograms())
 
     def flight_record(self) -> dict:
         try:
@@ -636,6 +682,11 @@ class ReplicaRouter:
             "serve_prefix_hits", "serve_prefix_lookups",
             "serve_prefix_cached_pages", "serve_prefix_shared_pages",
             "serve_prefix_cow_copies", "serve_prefix_evicted_pages",
+            # device-cost + sentinel aggregates (ISSUE 15): present
+            # only on replicas running with the cost registry /
+            # sentinel on — the per-request cost records' fleet totals
+            "serve_modeled_gflops", "serve_page_rounds",
+            "serve_perf_regressions", "serve_perf_bad_rounds",
         )
         for key in additive:
             vals = [c[key] for c in per.values() if key in c]

@@ -60,7 +60,8 @@ Replica fleets (ISSUE 14): `engine=` also accepts a `ReplicaRouter`
 uses (submit/cancel/counters/health/prometheus_metrics/flight_record/
 start/stop + the admission limits), so the same handler serves N
 prefix-affinity-routed engine replicas: /metrics aggregates additive
-counters and merges in-process replicas' latency histograms, /health
+counters and merges the replicas' latency histograms (remote replicas'
+rebuilt from their scraped Prometheus exposition — ISSUE 15), /health
 answers for the fleet (alive while any replica takes traffic), and the
 SSE `id:` field carries "replica-rid" so streams stay attributable.
 """

@@ -27,6 +27,7 @@ __all__ = [
     "Histogram",
     "DEFAULT_LATENCY_BUCKETS_MS",
     "render_prometheus",
+    "histograms_from_prometheus",
     "PROMETHEUS_CONTENT_TYPE",
 ]
 
@@ -95,6 +96,40 @@ class Histogram:
             out.append((b, acc))
         out.append((float("inf"), count))
         return out
+
+    @classmethod
+    def from_cumulative(cls, name: str, cumulative, total: float,
+                        count: int, help_text: str = "") -> "Histogram":
+        """Rebuild a Histogram from its exposition form — [(bound,
+        cumulative_count), ...] WITHOUT the +Inf row, plus sum and
+        count. The inverse of `cumulative()`/`to_prom_lines()`: raw
+        cells are first-differences of the cumulative counts, the
+        overflow cell is count - last cumulative. This is how the
+        replica router rebuilds a REMOTE replica's distributions from
+        its scraped /metrics text (ISSUE 15: HTTPReplica histogram
+        proxying) so `merged` can fold them into the fleet view."""
+        pairs = sorted((float(b), int(c)) for b, c in cumulative)
+        bounds = tuple(b for b, _ in pairs)
+        h = cls(name, buckets=bounds, help_text=help_text)
+        prev = 0
+        cells = []
+        for _, c in pairs:
+            if c < prev:
+                raise ValueError(
+                    f"histogram {name!r}: non-monotone cumulative "
+                    f"bucket counts {pairs} — not a valid Prometheus "
+                    f"histogram")
+            cells.append(c - prev)
+            prev = c
+        overflow = int(count) - prev
+        if overflow < 0:
+            raise ValueError(
+                f"histogram {name!r}: count {count} below the last "
+                f"finite bucket's cumulative {prev}")
+        h._cells = cells + [overflow]
+        h._sum = float(total)
+        h._count = int(count)
+        return h
 
     @classmethod
     def merged(cls, histograms: Iterable["Histogram"]) -> "Histogram":
@@ -180,6 +215,58 @@ def render_prometheus(counters: Dict, histograms: Iterable[Histogram] = (),
     for h in histograms:
         lines.extend(h.to_prom_lines(prefix))
     return "\n".join(lines) + "\n"
+
+
+def histograms_from_prometheus(text: str) -> List[Histogram]:
+    """Reconstruct every histogram-typed metric in a Prometheus text
+    page (the inverse of `to_prom_lines`): `# TYPE <name> histogram`
+    declares one, its `<name>_bucket{le=...}` samples carry the
+    cumulative counts, `<name>_sum`/`<name>_count` the totals. Used by
+    `inference/router.HTTPReplica` to merge REMOTE replicas' latency
+    distributions into the fleet /metrics (ISSUE 15 — closing the
+    PR-14 documented gap that merged histograms covered in-process
+    replicas only). Malformed sections raise ValueError — a fleet view
+    silently missing one replica's distribution would misstate the
+    SLO."""
+    hist_names: List[str] = []
+    for line in text.splitlines():
+        parts = line.strip().split()
+        if len(parts) == 4 and parts[0] == "#" and parts[1] == "TYPE" \
+                and parts[3] == "histogram":
+            hist_names.append(parts[2])
+    if not hist_names:
+        return []
+    samples = parse_prometheus(text)
+    out: List[Histogram] = []
+    for name in hist_names:
+        buckets = samples.get(f"{name}_bucket", {})
+        cumulative = []
+        count = None
+        for labels, value in buckets.items():
+            le = None
+            for part in labels.split(","):
+                k, _, v = part.partition("=")
+                if k.strip() == "le":
+                    le = v.strip().strip('"')
+            if le is None:
+                raise ValueError(
+                    f"histogram {name!r}: bucket sample without an le "
+                    f"label ({labels!r})")
+            if le in ("+Inf", "inf", "Inf"):
+                count = int(value)
+            else:
+                cumulative.append((float(le), int(value)))
+        total = samples.get(f"{name}_sum", {}).get("")
+        n = samples.get(f"{name}_count", {}).get("")
+        if count is None:
+            count = int(n) if n is not None else None
+        if count is None or total is None or not cumulative:
+            raise ValueError(
+                f"histogram {name!r}: incomplete exposition (buckets="
+                f"{len(cumulative)}, sum={total}, count={count})")
+        out.append(Histogram.from_cumulative(
+            name, cumulative, total, count))
+    return out
 
 
 def parse_prometheus(text: str) -> Dict[str, Dict]:

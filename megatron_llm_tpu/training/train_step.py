@@ -55,6 +55,11 @@ from megatron_llm_tpu.optimizer.optimizer import OptimizerState, optimizer_step
         # the mint and _check_telemetry_parity pins inventory equality
         # + zero host callbacks vs the telemetry-off dp2 row.
         "dp2+telemetry": frozenset({"all-reduce"}),
+        # cost-registry-on specialization (ISSUE 15): same contract as
+        # +telemetry — mint-time cost capture READS the artifact
+        # (lower + cost/memory analysis) and may not change one op;
+        # the audit additionally pins compiled-FLOPs equality vs dp2.
+        "dp2+costs": frozenset({"all-reduce"}),
         # ZeRO-1 explicit decomposition (optimizer/zero1.py): the ISSUE
         # 10 contract — per-bucket reduce-scatter of grads, all-gather
         # of updated params, all-reduce for loss/denominator/grad-norm

@@ -153,8 +153,11 @@ class Trainer:
         from megatron_llm_tpu.telemetry import (
             NULL_TRACER,
             FlightRecorder,
+            GoodputLedger,
             Histogram,
+            PerfSentinel,
             SpanTracer,
+            detect_chip,
         )
 
         self.tracer = (SpanTracer(enabled=True) if tcfg.trace_dir
@@ -163,6 +166,27 @@ class Trainer:
         self._step_ms_hist = Histogram(
             "train_step_ms", help_text="wall ms per optimizer step "
             "(dispatch + loss fetch)")
+        # goodput & device-cost accounting (ISSUE 15): the ledger is
+        # ALWAYS on (pure host float adds — ledger-on training is
+        # bitwise ledger-off by construction); the cost registry is
+        # opt-in (mint-time capture pays one extra AOT compile per
+        # step specialization); the perf sentinel is armed by
+        # --perf_sentinel_ksigma > 0 and shares the watchdog's
+        # median+MAD machinery, pointed at step_ms.
+        self.ledger = GoodputLedger()
+        self.chip = detect_chip(override=tcfg.chip_spec)
+        self.costs = None
+        if tcfg.device_cost_registry:
+            from megatron_llm_tpu.telemetry import CostRegistry
+
+            self.costs = CostRegistry(chip=self.chip, owner=self).attach()
+        self.sentinel = PerfSentinel(
+            k_sigma=tcfg.perf_sentinel_ksigma,
+            window=max(tcfg.perf_sentinel_window, 4),
+            patience=max(tcfg.perf_sentinel_patience, 1),
+            recorder=self.recorder, name="train_step_ms")
+        self._last_step_minted = False
+        self._last_num_micro: Optional[int] = None
         self.timers = Timers(tcfg.timing_log_level, tcfg.timing_log_option,
                              tracer=self.tracer)
         self._n_params = 0  # set in setup(); enables the TFLOP/s log field
@@ -521,6 +545,15 @@ class Trainer:
             from megatron_llm_tpu.parallel.multihost import globalize_batch
 
             batch = globalize_batch(batch, self.ctx)
+        # a fresh mint means this call pays trace+compile: the goodput
+        # ledger books its wall under "compile", and (registry on) the
+        # mint's cost is captured right after the call below
+        minted = num_micro not in self._train_steps
+        self._last_step_minted = minted
+        # which specialization this step ran: the MFU gauge's registry
+        # lookup must read THIS mint's record, not whichever record was
+        # captured first (a rampup run holds several)
+        self._last_num_micro = num_micro
         step_fn = self._get_step_fn(num_micro)
         first_step = state.iteration == 0 and not self._run_facts_logged
         # the loss watchdog's in-step skip gate: +inf until the window
@@ -533,6 +566,17 @@ class Trainer:
         )
         state.params = params
         state.opt_state = opt_state
+        if minted and self.costs is not None:
+            # compiled-cost capture at MINT time (ISSUE 15): once per
+            # step specialization, with the post-step params/opt trees
+            # (same avals; the pre-step buffers were donated). Pays one
+            # extra AOT compile — the documented price of the opt-in.
+            self.costs.capture(
+                "train.pipeline_step"
+                if self.pcfg.pipeline_parallel_size > 1 else "train.step",
+                num_micro, step_fn,
+                (params, opt_state, batch, jnp.float32(lr),
+                 jnp.float32(wd), dropout_rng, spike_thr))
         if first_step:
             # AFTER the first execution (avals of the donated args are
             # unchanged, and the opt-in memory relower never races the
@@ -684,12 +728,81 @@ class Trainer:
             tflops = tok_s * 6 * self._n_params / 1e12
             line += (f" | tokens/sec: {tok_s:.1f} | "
                      f"model TFLOP/s: {tflops:.2f}")
+        # goodput partition + live MFU/roofline gauges (ISSUE 15): the
+        # ledger counters re-set each log interval (cumulative seconds
+        # move every step), the MFU/roofline gauges only when a chip
+        # spec is known — an MFU against a guessed peak is worse than
+        # no gauge (telemetry/chipspec.py)
+        for name, val in self.ledger.counters().items():
+            self.timers.gauge(name, val)
+        self._device_cost_gauges(elapsed, stats["batch_size"])
         print(line, flush=True)
         # timer dump at the log cadence; only per-iteration timers get the
         # log_interval normalizer (one-shot timers like setup/save would be
         # misreported) — ref: timers.log call training.py:618
         self.timers.log(["batch-generator", "train-step"],
                         normalizer=self.tcfg.log_interval)
+
+    def _device_cost_gauges(self, elapsed: float, batch_size: int):
+        """Live MFU + per-executable roofline gauges (ISSUE 15).
+
+        train_mfu is the last logged step's achieved fraction of the
+        chip peak; train_mfu_effective is the ISSUE formula — step
+        FLOPs x productive steps / WALL / peak — i.e. MFU debited for
+        every non-productive second the goodput ledger booked. The
+        FLOPs numerator is the cost registry's train.step record when
+        captured (`--device_cost_registry`), else the analytic
+        6N+attention model (telemetry/chipspec.train_flops_per_token) —
+        the gauge's `train_mfu_source` names which, because the two are
+        different claims (GUIDE: the modeled-FLOPs caveat). Gauges are
+        ABSENT without a known chip spec."""
+        if self.chip is None or not self._n_params or elapsed <= 0:
+            return
+        n_dev = self.ctx.mesh.size if self.ctx is not None else 1
+        peak = self.chip.peak_flops_for(
+            str(self.cfg.compute_dtype)) * n_dev
+        # the record of the specialization the logged step ACTUALLY ran
+        # (keyed num_microbatches): under batch-size rampup several
+        # specializations are captured, and reading an arbitrary one
+        # would misstate MFU by the microbatch ratio while claiming the
+        # "registry" source
+        key = getattr(self, "_last_num_micro", None)
+        rec = (self.costs.record("train.step", key)
+               or self.costs.record("train.pipeline_step", key)) \
+            if self.costs is not None and key is not None else None
+        if rec is not None and rec.flops:
+            step_flops = rec.flops
+            source = "registry"
+        else:
+            from megatron_llm_tpu.telemetry.chipspec import (
+                train_flops_per_token,
+            )
+
+            step_flops = train_flops_per_token(
+                self._n_params, self.cfg.num_layers,
+                self.cfg.hidden_size, self.cfg.seq_length,
+            ) * batch_size * self.cfg.seq_length
+            source = "analytic"
+        self.timers.gauge("train_mfu",
+                          round(step_flops / elapsed / peak, 6))
+        snap = self.ledger.snapshot()
+        if snap["wall_s"] > 0 and snap["productive_steps"]:
+            self.timers.gauge(
+                "train_mfu_effective",
+                round(step_flops * snap["productive_steps"]
+                      / snap["wall_s"] / peak, 6))
+        self.timers.gauge("train_mfu_source", source)
+        self.timers.gauge("chip_spec", self.chip.label())
+        if rec is not None and rec.bytes_accessed:
+            # per-executable achieved-GB/s roofline: the step's
+            # compiled bytes-accessed over its measured wall vs the
+            # chip's HBM rate
+            gbps = rec.bytes_accessed / elapsed / 1e9
+            self.timers.gauge("train_step_achieved_gbps",
+                              round(gbps, 1))
+            self.timers.gauge(
+                "train_step_hbm_frac",
+                round(gbps * 1e9 / (self.chip.hbm_bytes_s * n_dev), 4))
 
     def _tb_log(self, state, stats, elapsed):
         """Tensorboard/wandb scalars — own cadence, independent of the
@@ -722,6 +835,15 @@ class Trainer:
         # watchdog/async-checkpoint path is doing its job
         w.add_scalar("loss-watchdog-skipped", self.watchdog.skipped, it)
         w.add_scalar("loss-watchdog-rollbacks", self.watchdog.rollbacks, it)
+        # goodput cumulative counters (ISSUE 15): the wall-time
+        # partition as scalars a dashboard can rate() over, plus the
+        # headline fraction; sentinel trips when armed
+        snap = self.ledger.snapshot()
+        w.add_scalar("goodput-fraction", snap["goodput_fraction"], it)
+        for b, v in snap["buckets"].items():
+            w.add_scalar(f"goodput-{b}-seconds", v, it)
+        if self.sentinel.enabled:
+            w.add_scalar("perf-sentinel-trips", self.sentinel.trips, it)
         if self._ckpt_manager is not None and self._ckpt_manager.saves:
             w.add_scalar("ckpt-blocked-ms",
                          self._ckpt_manager.last_blocked_ms, it)
@@ -763,6 +885,7 @@ class Trainer:
         if not self.tcfg.save:
             return
         mgr = self._get_ckpt_manager()
+        t_save = time.perf_counter()
         self.timers("save-checkpoint").start()
         mgr.save(
             state.iteration, state.params,
@@ -779,6 +902,11 @@ class Trainer:
                             blocked_ms=round(mgr.last_blocked_ms, 3))
         if blocking:
             mgr.wait_until_finished()
+        if self.ledger.started:
+            # goodput: the loop's whole save-side stall — dispatch,
+            # previous-save tail, and (blocking) the commit wait
+            self.ledger.note("checkpoint",
+                             time.perf_counter() - t_save)
         print(f"saved checkpoint at iteration {state.iteration} to "
               f"{self.tcfg.save}"
               f"{' (committed)' if blocking else ' (async)'}", flush=True)
@@ -796,6 +924,7 @@ class Trainer:
                   "dir is configured; continuing in skip-only mode",
                   flush=True)
             return False
+        t_roll = time.perf_counter()
         # the in-flight async save must finalize first: it is newer than
         # anything on disk and about to become the rollback target
         self._get_ckpt_manager().wait_until_finished()
@@ -838,11 +967,15 @@ class Trainer:
         # trail + per-step record that led to this rollback, dumped
         # BEFORE training resumes — the artifact names the failing
         # step range even if the run later dies for another reason
+        if self.ledger.started:
+            # the rollback's reload/wait stall is watchdog-spent wall
+            self.ledger.note("watchdog", time.perf_counter() - t_roll)
         self.recorder.dump(
             self._flight_record_dir(), "watchdog-rollback",
             extra={"restored_step": iteration,
                    "poison_window": poison,
-                   "rollback": self.watchdog.rollbacks})
+                   "rollback": self.watchdog.rollbacks,
+                   "goodput": self.ledger.snapshot()})
         print(f"LOSS WATCHDOG ROLLBACK: reloaded iteration {iteration} "
               f"from {self.tcfg.save}; data iterator fast-forwarded past "
               f"the {poison}-iteration poison window "
@@ -870,6 +1003,9 @@ class Trainer:
                 state.iteration < tcfg.train_iters
 
         last_log_time = time.time()
+        # the goodput wall clock starts with the loop: every second
+        # from here lands in exactly one ledger bucket (ISSUE 15)
+        self.ledger.start()
         while keep_going():
             # every span this iteration emits (batch-generator,
             # train-step, save-checkpoint via the timers ride-along)
@@ -877,6 +1013,7 @@ class Trainer:
             # the rid/step correlation model (ISSUE 13)
             self.tracer.set_context(step=state.iteration + 1)
             self.timers("batch-generator").start()
+            t_fetch = time.perf_counter()
             try:
                 text = next(data_iter)
             except StopIteration:
@@ -884,6 +1021,8 @@ class Trainer:
                 break
             finally:
                 self.timers("batch-generator").stop()
+                self.ledger.note("data_wait",
+                                 time.perf_counter() - t_fetch)
             step_rng = None
             if dropout_rng is not None:
                 step_rng = jax.random.fold_in(dropout_rng, state.iteration)
@@ -906,21 +1045,31 @@ class Trainer:
             self.timers("train-step").stop()
             stats["loss"] = loss_val
             elapsed = time.time() - t0
+            # loss watchdog: a bad step (NaN/inf or >k-sigma spike) was
+            # already SKIPPED on device by the spike-threshold gate; the
+            # host side counts the streak and escalates to a rollback
+            # after `spike_rollback_patience` consecutive bad steps.
+            bad = self.watchdog.observe(loss_val, step=state.iteration)
+            # goodput classification (ISSUE 15): this step's wall lands
+            # in exactly one bucket — a fresh mint paid trace+compile
+            # (the first execution rides the compile bucket, the
+            # documented semantics), a watchdog-skipped step spent wall
+            # the device discarded, everything else is productive.
+            bucket = ("compile" if self._last_step_minted
+                      else "watchdog" if bad else "productive")
+            self.ledger.note(bucket, elapsed)
             # flight-recorder step trail + the step-ms histogram
             # (host floats only — the loss was already fetched above)
             self._step_ms_hist.observe(elapsed * 1e3)
             self.recorder.record("step", step=state.iteration,
                                  loss=loss_val,
-                                 ms=round(elapsed * 1e3, 3))
+                                 ms=round(elapsed * 1e3, 3),
+                                 bucket=bucket)
             if self._trace_active and state.iteration >= tcfg.profile_step_end:
                 jax.profiler.stop_trace()
                 self._trace_active = False
 
-            # loss watchdog: a bad step (NaN/inf or >k-sigma spike) was
-            # already SKIPPED on device by the spike-threshold gate; the
-            # host side counts the streak and escalates to a rollback
-            # after `spike_rollback_patience` consecutive bad steps.
-            if self.watchdog.observe(loss_val, step=state.iteration):
+            if bad:
                 self.tracer.instant("watchdog_bad", loss=loss_val,
                                     streak=self.watchdog.consecutive_bad)
                 print(f"loss watchdog: bad step at iteration "
@@ -930,6 +1079,27 @@ class Trainer:
                       flush=True)
                 if self.watchdog.should_rollback():
                     self._rollback(state)
+            elif bucket == "productive" and self.sentinel.enabled:
+                # perf sentinel (ISSUE 15): productive steps only —
+                # compile steps would poison the latency baseline the
+                # same way a spike would poison the loss window
+                if self.sentinel.observe(elapsed * 1e3,
+                                         step=state.iteration):
+                    self.timers.gauge("perf_sentinel_trips",
+                                      self.sentinel.trips)
+                    self.tracer.instant(
+                        "perf_regression", step_ms=round(elapsed * 1e3, 3))
+                    # the same postmortem path as poison/rollback: the
+                    # ring (with the perf_bad verdict trail) + the
+                    # goodput partition at the moment of the trip
+                    self.recorder.dump(
+                        self._flight_record_dir(), "perf-regression",
+                        extra={"step": state.iteration,
+                               "trip": self.sentinel.trips,
+                               "step_ms": round(elapsed * 1e3, 3),
+                               "threshold_ms": round(
+                                   self.sentinel.last_threshold, 3),
+                               "goodput": self.ledger.snapshot()})
 
             if state.iteration % tcfg.log_interval == 0:
                 self._training_log(state, stats, elapsed)
@@ -988,7 +1158,10 @@ class Trainer:
                         self._flight_record_dir(), "sigterm",
                         extra={"step": state.iteration,
                                "consumed_train_samples":
-                                   state.consumed_train_samples})
+                                   state.consumed_train_samples,
+                               "goodput": self.ledger.snapshot(),
+                               **({"costs": self.costs.snapshot()}
+                                  if self.costs is not None else {})})
                     host_barrier("emergency-save-done")
                     break
             if tcfg.exit_duration_in_mins is not None:

@@ -27,9 +27,9 @@ path and WandB (`loss_watchdog_skipped` / `loss_watchdog_rollbacks`).
 
 from __future__ import annotations
 
-import collections
 import math
-from typing import Deque
+
+from megatron_llm_tpu.telemetry.sentinel import RobustWindow
 
 
 class LossWatchdog:
@@ -50,11 +50,11 @@ class LossWatchdog:
         # step, so a dumped artifact shows the verdict trail that led
         # to the death/rollback — not just the final counter values
         self.recorder = recorder
-        # a window smaller than min_history could never arm the
-        # threshold (the deque caps below it) — clamp so every accepted
-        # window size actually detects spikes
-        self.min_history = min(min_history, window)
-        self._window: Deque[float] = collections.deque(maxlen=window)
+        # the ONE robust statistic, shared with the perf-regression
+        # sentinel (telemetry/sentinel.py, ISSUE 15): median + MAD over
+        # a sliding window with the min_history arming clamp
+        self._stat = RobustWindow(window=window, min_history=min_history)
+        self.min_history = self._stat.min_history
         self.consecutive_bad = 0
         self.skipped = 0
         self.rollbacks = 0
@@ -62,24 +62,14 @@ class LossWatchdog:
     # -- robust running stat ----------------------------------------------
 
     def _median_mad(self):
-        xs = sorted(self._window)
-        n = len(xs)
-        med = (xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2]))
-        dev = sorted(abs(x - med) for x in xs)
-        mad = (dev[n // 2] if n % 2 else 0.5 * (dev[n // 2 - 1] + dev[n // 2]))
-        return med, mad
+        return self._stat.median_mad()
 
     def threshold(self) -> float:
         """Loss value above which the current step is a spike; +inf while
-        spike detection is off or the window is too short to be trusted.
-        1.4826 * MAD estimates sigma for a normal population; the floor
-        keeps a perfectly flat window (MAD 0 — e.g. synthetic data) from
-        flagging every step."""
-        if self.k_sigma <= 0 or len(self._window) < self.min_history:
-            return math.inf
-        med, mad = self._median_mad()
-        sigma = max(1.4826 * mad, 1e-3 * abs(med), 1e-8)
-        return med + self.k_sigma * sigma
+        spike detection is off or the window is too short to be trusted
+        (RobustWindow.threshold — 1.4826*MAD sigma with the flat-window
+        floor)."""
+        return self._stat.threshold(self.k_sigma)
 
     # -- per-step protocol -------------------------------------------------
 
@@ -101,7 +91,7 @@ class LossWatchdog:
                     threshold=thr, streak=self.consecutive_bad)
         else:
             self.consecutive_bad = 0
-            self._window.append(loss)
+            self._stat.push(loss)
         return bad
 
     def should_rollback(self) -> bool:
@@ -114,7 +104,7 @@ class LossWatchdog:
         one) and the bad-streak ends."""
         self.rollbacks += 1
         self.consecutive_bad = 0
-        self._window.clear()
+        self._stat.clear()
         if self.recorder is not None:
             self.recorder.record("watchdog_rollback", step=step,
                                  restored_step=restored_step,

@@ -1,0 +1,26 @@
+"""GR006 cost-accounting fixture (ISSUE 15): per-round device-cost
+bookkeeping that SYNCS THE DEVICE to price the round. The test
+monkeypatches lint.HOT_PATHS to scope `CostBook.note_round` and
+`CostBook.request_cost` hot — in the real repo that list is
+telemetry/costs.py CostRegistry.record / CostRecord.modeled_seconds
+and engine.py _note_dispatch / _request_cost: the registry's capture
+(lower + cost_analysis) happens ONCE at mint time; the per-round /
+per-retire paths may only read host counters and the already-captured
+record. Fetching a device value to "measure" a round defeats the whole
+design — the modeled number exists so no transfer is needed."""
+import numpy as np
+
+
+class CostBook:
+    def note_round(self, rec, dt_ms, live_logits):
+        # pricing the round by fetching the device output it just
+        # produced: a per-round transfer for a gauge
+        sample = float(live_logits[0, 0])  # LINT
+        return rec["flops"] / max(dt_ms, 1e-9) + sample * 0
+
+    def request_cost(self, slot, lengths_dev):
+        # the host mirror exists precisely so this fetch is never
+        # needed — reading the device lengths per retirement stalls
+        # the scheduler
+        final_len = np.asarray(lengths_dev)  # LINT
+        return {"tokens": int(final_len[slot])}  # LINT

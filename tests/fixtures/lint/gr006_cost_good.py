@@ -1,0 +1,30 @@
+"""GR006 cost-accounting counterpart (ISSUE 15): per-round device-cost
+bookkeeping as pure host arithmetic. The registry record was captured
+ONCE at mint time (lower + cost_analysis — outside any hot path); the
+round path does a dict lookup and float math on counters the scheduler
+already holds, and the per-request record reads the HOST length mirror
+— cost-accounting-on rounds stay bitwise cost-accounting-off because
+pricing never touches a device value. This is the
+telemetry/costs.CostRegistry.record / engine._request_cost pattern."""
+
+
+class CostBook:
+    def __init__(self):
+        self._records = {}
+        self.modeled_ms = 0.0
+        self.measured_ms = 0.0
+
+    def note_round(self, key, dt_ms, peak_flops_s):
+        # dict lookup + float adds on host scalars: the mint-time
+        # record prices the round, no transfer needed
+        rec = self._records.get(key)
+        self.measured_ms += dt_ms
+        if rec is not None and rec.get("flops"):
+            self.modeled_ms += rec["flops"] / peak_flops_s * 1e3
+
+    def request_cost(self, slot, lengths_host, prefill_start):
+        # the host-authoritative length mirror (a numpy array the
+        # scheduler maintains itself) is the source — indexing it is
+        # host memory, not a device sync
+        final_len = lengths_host[slot]
+        return {"computed": max(final_len - prefill_start, 0)}

@@ -116,6 +116,32 @@ class TestLintRules:
             else:
                 assert findings == [], [f.to_dict() for f in findings]
 
+    def test_gr006_cost_accounting_fixtures(self, monkeypatch):
+        """ISSUE 15: per-round/per-retire device-cost bookkeeping must
+        be pure host arithmetic — the mint-time registry record exists
+        so pricing a round never costs a transfer. The bad fixture
+        fetches device values to price rounds/requests (fires exactly
+        on the marked lines); the good fixture is the
+        CostRegistry.record / engine._request_cost pattern — dict
+        lookups and host-mirror indexing (quiet)."""
+        hot = {"CostBook.note_round", "CostBook.request_cost"}
+        for name, expect_fire in (("gr006_cost_bad.py", True),
+                                  ("gr006_cost_good.py", False)):
+            src = _read_fixture(name)
+            monkeypatch.setitem(lint.HOT_PATHS, name, hot)
+            findings = lint.lint_source(src, name)
+            marked = {i for i, ln in enumerate(src.splitlines(), 1)
+                      if "# LINT" in ln}
+            got = {f.line for f in findings if f.rule == "GR006"}
+            if expect_fire:
+                assert got == marked and marked, (
+                    f"{name}: GR006 fired on {sorted(got)}, marks "
+                    f"{sorted(marked)}")
+                assert {f.rule for f in findings} == {"GR006"}, [
+                    f.to_dict() for f in findings]
+            else:
+                assert findings == [], [f.to_dict() for f in findings]
+
     def test_telemetry_emit_sites_are_hot_paths(self):
         """The GR006 scope covers the telemetry emit sites (ISSUE 13):
         a device sync added to span/event/histogram emission — code
@@ -399,10 +425,12 @@ class TestRepoGate:
                     f"HOT_PATHS names {q} but {rel} has no def {meth}")
 
     def test_graft_check_gate(self, tmp_path):
-        """The tier-1 CI wiring: the gate tool itself, both passes, over
-        the real repo, under JAX_PLATFORMS=cpu — exit 0, >= 6 entry
-        points audited, collective inventories pinned on >= 2 mesh
-        shapes, markers consistent, KNOWN_FAILURES.md linked + present."""
+        """The tier-1 CI wiring: the gate tool itself, all THREE passes
+        (lint + audit + costs, ISSUE 15), over the real repo, under
+        JAX_PLATFORMS=cpu — exit 0, >= 6 entry points audited,
+        collective inventories pinned on >= 2 mesh shapes, markers
+        consistent, KNOWN_FAILURES.md linked + present, and the
+        compiled-cost diff clean against the checked-in baseline."""
         out = tmp_path / "report.json"
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         proc = subprocess.run(
@@ -430,3 +458,92 @@ class TestRepoGate:
         # the honest-triage doc the report links must be checked in
         assert aud["known_failures"] == "KNOWN_FAILURES.md"
         assert os.path.exists(os.path.join(_REPO, "KNOWN_FAILURES.md"))
+        # compiled-cost regression gate (ISSUE 15): clean vs baseline,
+        # with real per-contract FLOPs rows on both hot-path families
+        costs = report["costs"]
+        assert costs["ok"], costs
+        assert not costs["regressions"] and not costs["missing_keys"] \
+            and not costs["stale_keys"]
+        assert any(k.startswith("engine.") for k in costs["rows"])
+        assert "train.step[dp2]" in costs["rows"]
+        assert costs["rows"]["train.step[dp2]"]["flops"] > 0
+        # the +costs / cost-registry parity rows lowered and passed
+        tags = {(t["contract"], t["mesh"]) for t in aud["targets"]
+                if t["facts"].get("costs")}
+        assert ("train.step", "dp2+costs") in tags
+        assert ("engine.decode_scan", "single") in {
+            (c, m) for c, m in tags if c.startswith("engine.")} or any(
+            c == "engine.decode_scan" for c, _ in tags)
+
+    def test_cost_gate_fails_on_injected_regression(self, tmp_path):
+        """ISSUE 15 acceptance: a deliberately injected per-contract
+        FLOPs/temp-bytes regression — simulated by halving the
+        baseline's pinned values, exactly what the checked-in file
+        would look like if an entry point's compiled cost silently
+        doubled — fails `graft_check.py costs` loudly. Also: a stale
+        baseline key (an audited row that no longer exists) fails, the
+        same only-shrinks-honestly workflow as the lint baseline. Runs
+        run_costs directly against a synthetic audit report built FROM
+        the checked-in baseline (a clean world by construction), no
+        subprocess needed."""
+        from tools.graft_check import (
+            COST_BASELINE,
+            load_cost_baseline,
+            run_costs,
+        )
+
+        base = load_cost_baseline(COST_BASELINE)
+        # a fake audit report whose rows ARE the baseline (a clean
+        # world), then inject the regression baseline-side
+        rows = {k: {"flops": e["flops"], "temp_bytes": e["temp_bytes"]}
+                for k, e in base.items()}
+        fake_report = {"targets": [
+            {"contract": k.split("[")[0],
+             "mesh": k.split("[")[1].rstrip("]"),
+             "ok": True,
+             "facts": {"flops": v["flops"],
+                       "temp_bytes": v["temp_bytes"]}}
+            for k, v in rows.items()]}
+        clean = run_costs(fake_report, baseline_path=COST_BASELINE)
+        assert clean["ok"], clean
+
+        injected = {"_comment": [], "entries": []}
+        for k, e in base.items():
+            entry = dict(e)
+            injected["entries"].append(entry)
+        # halve one engine row's flops and one train row's temp bytes:
+        # current measurements are now a >=2x "regression" vs baseline
+        eng_key = next(k for k in rows if k.startswith("engine."))
+        trn_key = next(k for k in rows if k.startswith("train.step"))
+        for entry in injected["entries"]:
+            if entry["key"] == eng_key:
+                entry["flops"] = max(entry["flops"] // 2, 1)
+            if entry["key"] == trn_key and entry.get("temp_bytes"):
+                entry["temp_bytes"] = max(entry["temp_bytes"] // 2, 1)
+        p = tmp_path / "cost_baseline.json"
+        p.write_text(json.dumps(injected))
+        bad = run_costs(fake_report, baseline_path=str(p))
+        assert not bad["ok"]
+        assert any(eng_key in r and "flops" in r
+                   for r in bad["regressions"]), bad["regressions"]
+        assert any(trn_key in r and "temp_bytes" in r
+                   for r in bad["regressions"]), bad["regressions"]
+        # stale-key workflow: a baseline entry whose audited row is gone
+        injected["entries"].append(
+            {"key": "engine.retired_contract[single]", "flops": 1,
+             "temp_bytes": 1, "justification": "x"})
+        p.write_text(json.dumps(injected))
+        stale = run_costs(fake_report, baseline_path=str(p))
+        assert "engine.retired_contract[single]" in stale["stale_keys"]
+        # missing-key workflow: a new audited row the baseline lacks
+        fake_report["targets"].append(
+            {"contract": "engine.new_entry", "mesh": "single",
+             "ok": True, "facts": {"flops": 10, "temp_bytes": 10}})
+        missing = run_costs(fake_report, baseline_path=str(p))
+        assert "engine.new_entry[single]" in missing["missing_keys"]
+        # justification discipline: the loader rejects empty ones
+        p.write_text(json.dumps({"entries": [
+            {"key": "x[y]", "flops": 1, "temp_bytes": 1,
+             "justification": "  "}]}))
+        with pytest.raises(ValueError, match="justification"):
+            load_cost_baseline(str(p))

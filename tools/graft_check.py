@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """graft-check: the repo's static-analysis gate (ISSUE 7).
 
-Two passes over the real package, one exit code:
+Three passes over the real package, one exit code:
 
   python tools/graft_check.py lint            # pass 1: AST trace-discipline
   python tools/graft_check.py audit           # pass 2: AOT compile-contract
+  python tools/graft_check.py costs           # pass 3: compiled-cost diff
   python tools/graft_check.py all --json out.json
 
 - `lint` runs the pure-AST JAX linter (analysis/lint.py, rules
@@ -22,8 +23,20 @@ Two passes over the real package, one exit code:
   host callbacks, fp64 and temp-memory budgets against the compiled
   artifacts (analysis/audit.py). Pre-existing slow-suite failures are
   triaged in KNOWN_FAILURES.md, which the report links.
+- `costs` (ISSUE 15) diffs the audit's per-contract compiled
+  cost_analysis FLOPs and memory_analysis temp bytes against the
+  checked-in baseline (megatron_llm_tpu/analysis/cost_baseline.json)
+  — the compile-cost regression gate: a silent 2x FLOPs regression in
+  any jitted entry point fails CI loudly, long before a bench run
+  notices the slowdown. Same stale-key/justification workflow as the
+  lint baseline: MISSING keys (new audited rows) and STALE keys
+  (audited rows gone) both fail; `--update-costs --justify "..."`
+  rewrites the baseline with the current measurements, stamping the
+  justification on every entry whose value moved. Under `all` the
+  costs pass reuses the audit report already computed — one lowering
+  pass feeds both gates.
 
-Runs anywhere in < 60 s with JAX_PLATFORMS=cpu (the audit sets it
+Runs anywhere in < 90 s with JAX_PLATFORMS=cpu (the audit sets it
 itself). Exit codes: 0 clean, 1 findings/violations, 2 usage.
 """
 
@@ -40,6 +53,15 @@ if _REPO not in sys.path:
 
 BASELINE = os.path.join(
     _REPO, "megatron_llm_tpu", "analysis", "lint_baseline.json")
+COST_BASELINE = os.path.join(
+    _REPO, "megatron_llm_tpu", "analysis", "cost_baseline.json")
+
+# regression tolerances: flops from XLA's HLO cost analysis are
+# deterministic per build, so the flops bar is tight (and far below
+# the "silent 2x" the gate exists to catch); temp bytes move with
+# compiler fusion choices, so the bar is looser.
+COST_FLOPS_MAX_RATIO = 1.25
+COST_TEMP_MAX_RATIO = 1.5
 
 
 def run_lint(list_keys: bool = False) -> dict:
@@ -105,23 +127,185 @@ def run_audit() -> dict:
     return report
 
 
+def _cost_rows(audit_report: dict) -> dict:
+    """One {key: {"flops", "temp_bytes"}} row per (contract, mesh tag)
+    from the audit's targets. Instrumented twin rows (quantized /
+    telemetry / cost-registry engines) are excluded — the parity
+    checks already pin them equal to the plain rows, and one row per
+    entry point is what a regression diff needs; device-shortage rows
+    (no facts) are skipped."""
+    rows = {}
+    for t in audit_report.get("targets", []):
+        facts = t.get("facts", {})
+        if any(facts.get(f) for f in ("quantized", "telemetry", "costs")):
+            continue
+        if "flops" not in facts:
+            continue  # failed to lower / backend without cost analysis
+        key = f"{t['contract']}[{t['mesh']}]"
+        if key in rows:
+            continue  # first (plain) row wins
+        tmp = facts.get("temp_bytes")
+        rows[key] = {"flops": int(facts["flops"]),
+                     "temp_bytes": int(tmp)
+                     if isinstance(tmp, int) else None}
+    return rows
+
+
+def load_cost_baseline(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        raw = json.load(fh)
+    out = {}
+    for e in raw.get("entries", []):
+        if not str(e.get("justification", "")).strip():
+            raise ValueError(
+                f"cost baseline entry {e.get('key')!r} has no "
+                f"justification — every pinned cost needs one "
+                f"(when/why this number is what it is)")
+        out[e["key"]] = e
+    return out
+
+
+def run_costs(audit_report=None, baseline_path: str = COST_BASELINE,
+              update: bool = False, justify: str = "") -> dict:
+    """Pass 3: diff the audit's per-contract FLOPs/temp-bytes against
+    the checked-in baseline (module docstring)."""
+    if audit_report is None:
+        audit_report = run_audit()
+    rows = _cost_rows(audit_report)
+    if update:
+        if not justify.strip():
+            print("costs: --update-costs requires --justify TEXT "
+                  "(why the pinned numbers moved)")
+            return {"ok": False, "error": "missing --justify"}
+        old = {}
+        if os.path.exists(baseline_path):
+            old = load_cost_baseline(baseline_path)
+        entries = []
+        for key in sorted(rows):
+            prev = old.get(key)
+            unchanged = (prev is not None
+                         and prev.get("flops") == rows[key]["flops"]
+                         and prev.get("temp_bytes")
+                         == rows[key]["temp_bytes"])
+            entries.append({
+                "key": key, **rows[key],
+                "justification": prev["justification"] if unchanged
+                else justify.strip(),
+            })
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            json.dump({
+                "_comment": [
+                    "graft-check compiled-cost baseline (ISSUE 15): the",
+                    "audit reference configs' per-contract cost_analysis",
+                    "FLOPs and memory_analysis temp bytes, one entry per",
+                    "(contract, mesh tag). `graft_check.py costs` fails on",
+                    f"flops > {COST_FLOPS_MAX_RATIO}x or temp_bytes >",
+                    f"{COST_TEMP_MAX_RATIO}x baseline, on MISSING keys",
+                    "(new audited rows) and on STALE keys (rows gone).",
+                    "Update: `python tools/graft_check.py costs",
+                    "--update-costs --justify '<why the numbers moved>'`.",
+                ],
+                "entries": entries,
+            }, fh, indent=1, sort_keys=False)
+            fh.write("\n")
+        print(f"costs: baseline updated -> {baseline_path} "
+              f"({len(entries)} entries)")
+        return {"ok": True, "updated": len(entries),
+                "baseline": os.path.relpath(baseline_path, _REPO)}
+
+    try:
+        baseline = load_cost_baseline(baseline_path)
+    except FileNotFoundError:
+        print(f"costs: no baseline at {baseline_path} — create it with "
+              f"--update-costs --justify '...'")
+        return {"ok": False, "error": "missing baseline",
+                "rows": rows}
+    regressions, improved, missing = [], [], []
+    for key in sorted(rows):
+        row = rows[key]
+        base = baseline.get(key)
+        if base is None:
+            missing.append(key)
+            continue
+        for field, ratio in (("flops", COST_FLOPS_MAX_RATIO),
+                             ("temp_bytes", COST_TEMP_MAX_RATIO)):
+            now, then = row.get(field), base.get(field)
+            if not isinstance(now, int) or not isinstance(then, int) \
+                    or then <= 0:
+                continue
+            if now > then * ratio:
+                regressions.append(
+                    f"{key}: {field} {then} -> {now} "
+                    f"({now / then:.2f}x > the {ratio}x gate) — a "
+                    f"compile-cost regression in this entry point; "
+                    f"fix it, or re-baseline WITH justification")
+            elif now * ratio < then:
+                improved.append(
+                    f"{key}: {field} {then} -> {now} (improved — "
+                    f"refresh the baseline to pin the win)")
+    stale = sorted(set(baseline) - set(rows))
+    for r in regressions:
+        print(f"COSTS REGRESSION {r}")
+    for k in missing:
+        print(f"COSTS MISSING baseline key {k} (new audited row — add "
+              f"it via --update-costs --justify '...')")
+    for k in stale:
+        print(f"COSTS STALE baseline key {k} (audited row gone — "
+              f"refresh the baseline)")
+    for n in improved:
+        print(f"COSTS NOTE {n}")
+    ok = not regressions and not missing and not stale
+    print(f"costs: {len(rows)} audited rows vs {len(baseline)} "
+          f"baselined, {len(regressions)} regressions, {len(missing)} "
+          f"missing, {len(stale)} stale -> {'OK' if ok else 'FAIL'}")
+    return {
+        "ok": ok,
+        "rows": rows,
+        "regressions": regressions,
+        "missing_keys": missing,
+        "stale_keys": stale,
+        "improved": improved,
+        "flops_max_ratio": COST_FLOPS_MAX_RATIO,
+        "temp_max_ratio": COST_TEMP_MAX_RATIO,
+        "baseline": os.path.relpath(baseline_path, _REPO),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graft_check",
         description="JAX trace-discipline lint + AOT compile-contract "
                     "audit gate")
-    ap.add_argument("command", choices=("lint", "audit", "all"))
+    ap.add_argument("command", choices=("lint", "audit", "costs", "all"))
     ap.add_argument("--json", metavar="PATH",
                     help="write the full machine-readable report here")
     ap.add_argument("--list-keys", action="store_true",
                     help="print baseline keys for new lint findings")
+    ap.add_argument("--cost-baseline", metavar="PATH",
+                    default=COST_BASELINE,
+                    help="compiled-cost baseline to diff against "
+                         "(default: analysis/cost_baseline.json)")
+    ap.add_argument("--update-costs", action="store_true",
+                    help="rewrite the cost baseline with the current "
+                         "audit measurements (requires --justify)")
+    ap.add_argument("--justify", default="",
+                    help="justification stamped on updated cost-"
+                         "baseline entries")
     args = ap.parse_args(argv)
 
     report = {}
+    audit_report = None
     if args.command in ("lint", "all"):
         report["lint"] = run_lint(list_keys=args.list_keys)
+    if args.command in ("audit", "costs", "all"):
+        # ONE lowering pass feeds both the audit and the cost diff
+        audit_report = run_audit()
     if args.command in ("audit", "all"):
-        report["audit"] = run_audit()
+        report["audit"] = audit_report
+    if args.command in ("costs", "all"):
+        report["costs"] = run_costs(
+            audit_report, baseline_path=args.cost_baseline,
+            update=args.update_costs, justify=args.justify)
 
     ok = all(section["ok"] for section in report.values())
     report["ok"] = ok

@@ -118,6 +118,37 @@ def main():
                    help="bounded ring of recent structured engine "
                         "events (rounds, admissions, retirements) the "
                         "flight recorder keeps")
+    # ISSUE 15 goodput & device-cost accounting (docs/GUIDE.md
+    # "Goodput & device-cost accounting")
+    p.add_argument("--cost_registry", action="store_true",
+                   help="capture each minted executable's compiled "
+                        "cost (cost_analysis FLOPs/bytes + "
+                        "memory_analysis temp/args) at mint time: "
+                        "unlocks the per-request device-cost record on "
+                        "retire events, serve_modeled_gflops/"
+                        "serve_page_rounds aggregates, the "
+                        "serve_dispatch_overhead_pct gauge, and the "
+                        "labeled cost_* Prometheus samples on "
+                        "/metrics. One extra AOT compile per minted "
+                        "executable (pair with --warmup_compile so it "
+                        "all happens before traffic)")
+    p.add_argument("--chip_spec", type=str, default=None,
+                   choices=["v5e", "v5p", "v4"],
+                   help="override TPU-generation detection for the "
+                        "roofline denominators (telemetry/chipspec.py; "
+                        "default: detect from the engine's devices)")
+    p.add_argument("--perf_sentinel_ksigma", type=float, default=0.0,
+                   help="arm the decode-round perf-regression "
+                        "sentinel: patience consecutive rounds above "
+                        "median + ksigma * 1.4826*MAD of the recent "
+                        "per-token-advance latency trip it — flight-"
+                        "recorder trail, serve_perf_regressions "
+                        "counter, ring auto-dump into --record_dir. "
+                        "0 disables (default)")
+    p.add_argument("--perf_sentinel_window", type=int, default=64,
+                   help="sentinel baseline window (good rounds)")
+    p.add_argument("--perf_sentinel_patience", type=int, default=8,
+                   help="consecutive bad rounds that trip the sentinel")
     # ISSUE 14: serve from a mesh, not a chip (docs/GUIDE.md "Serving
     # on a tp mesh & replica routing")
     p.add_argument("--serving_tp", type=int, default=1,
@@ -233,6 +264,11 @@ def main():
                 trace_dir=args.trace_dir,
                 record_dir=args.record_dir,
                 flight_recorder_size=args.flight_recorder_size,
+                cost_registry=args.cost_registry,
+                chip_spec=args.chip_spec,
+                perf_sentinel_ksigma=args.perf_sentinel_ksigma,
+                perf_sentinel_window=args.perf_sentinel_window,
+                perf_sentinel_patience=args.perf_sentinel_patience,
             )
 
         if n_rep > 1:
@@ -282,6 +318,11 @@ def main():
              + (", SSE streaming" if args.stream else "")
              + (f", span tracing -> {args.trace_dir}"
                 if args.trace_dir else "")
+             + ((", cost registry"
+                 + (f" ({engine.chip.label()})" if engine.chip else ""))
+                if engine.costs is not None else "")
+             + (f", perf sentinel k={args.perf_sentinel_ksigma}"
+                if args.perf_sentinel_ksigma > 0 else "")
              + ", counters at /metrics (JSON + Prometheus), health at "
                "/health, flight record at /flight_record, profiler at "
                "POST /profile)"
