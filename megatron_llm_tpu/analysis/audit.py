@@ -360,6 +360,11 @@ def _audit_engine() -> List[TargetResult]:
         "engine.prefill_bucket": eng._PREFILL_CACHE_CAP,
         "engine.spec_verify": 2,
         "engine.page_copy": 1,
+        # cross-replica KV hand-off pair (ISSUE 17): ids is a traced
+        # fixed-width vector padded to max_pages_per_slot, so like
+        # page_copy each side is ONE executable forever
+        "engine.page_export": 1,
+        "engine.page_import": 1,
         "ops.weight_quant": 1,
     }
     for res in results:

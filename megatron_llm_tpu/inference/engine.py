@@ -713,6 +713,79 @@ def _make_page_copy_fn():
     return jax.jit(copy, donate_argnums=(0, 1, 2, 3))
 
 
+@compile_contract(
+    "engine.page_export",
+    max_variants=1,  # ids is a traced fixed-width vector: ONE executable
+    collectives={"single": frozenset(),
+                 # tp2: the pages axis is unsharded, so each chip
+                 # gathers its own group slice of every requested page —
+                 # ZERO collectives, pinned (a collective here would
+                 # mean the export resharded the pool)
+                 "tp2": frozenset()},
+    tmp_bytes_budget=1 << 20,
+    notes="disaggregated serving's donor-side page gather (ISSUE 17): "
+          "ids is padded to max_pages_per_slot with the null page, so "
+          "prefix length can never leak into the static signature")
+def _make_page_export_fn():
+    """One jitted batched whole-page gather (the donor half of the
+    cross-replica KV hand-off): rows `ids` of every layer's K and V
+    pool — AND, on an int8 engine, of every layer's scale pool — are
+    pulled into dense (max_pages_per_slot, ...) row blocks the host can
+    device_get and ship. `ids` is a fixed-width int32 vector padded
+    with the null page 0, so one executable serves every prefix length;
+    pad rows gather the dead page and are sliced off on the host.
+    Pools are NOT donated: an export is a read, and the donor keeps
+    serving from the same buffers."""
+
+    def export(pools_k, pools_v, pools_ks, pools_vs, ids):
+        rows_k = tuple(pk[ids] for pk in pools_k)
+        rows_v = tuple(pv[ids] for pv in pools_v)
+        rows_ks = tuple(ps[ids] for ps in pools_ks)
+        rows_vs = tuple(ps[ids] for ps in pools_vs)
+        return rows_k, rows_v, rows_ks, rows_vs
+
+    return jax.jit(export)
+
+
+@compile_contract(
+    "engine.page_import",
+    max_variants=1,  # same fixed-width ids idiom as the export
+    collectives={"single": frozenset(),
+                 # tp2: the replicated payload rows scatter into each
+                 # chip's own group slice of the page pools — ZERO
+                 # collectives, pinned, same argument as page_copy
+                 "tp2": frozenset()},
+    tmp_bytes_budget=1 << 20,
+    notes="disaggregated serving's receiver-side page scatter "
+          "(ISSUE 17): fixed-width ids padded with the null page; pad "
+          "rows scatter zeros into dead page 0, which is dead by the "
+          "null-page invariant")
+def _make_page_import_fn():
+    """One jitted batched whole-page scatter (the receiver half of the
+    cross-replica KV hand-off): payload row blocks land at rows `ids`
+    of every layer's K/V pool — and of every layer's scale pool on an
+    int8 engine, because a quantized page's KV is the (data, scale)
+    pair and splitting them would dequantize against a foreign scale.
+    `ids` is the same fixed-width null-padded vector the export uses;
+    pad rows carry zeros into the dead null page 0, which no page-table
+    row maps for reads. Pools are donated — the splice is in place,
+    exactly like page_copy."""
+
+    def imp(pools_k, pools_v, pools_ks, pools_vs, ids,
+            rows_k, rows_v, rows_ks, rows_vs):
+        pools_k = tuple(pk.at[ids].set(rk)
+                        for pk, rk in zip(pools_k, rows_k))
+        pools_v = tuple(pv.at[ids].set(rv)
+                        for pv, rv in zip(pools_v, rows_v))
+        pools_ks = tuple(ps.at[ids].set(rs)
+                         for ps, rs in zip(pools_ks, rows_ks))
+        pools_vs = tuple(ps.at[ids].set(rs)
+                         for ps, rs in zip(pools_vs, rows_vs))
+        return pools_k, pools_v, pools_ks, pools_vs
+
+    return jax.jit(imp, donate_argnums=(0, 1, 2, 3))
+
+
 class DecodeEngine:
     """Fixed-slot continuous-batching decode engine over a paged pool.
 
@@ -1085,6 +1158,33 @@ class DecodeEngine:
             contract_key=(), contract_owner=self, contract_budget=1)
         self._capture_cost("engine.page_copy", (), self._copy_fn,
                            self._null_copy_args)
+        # cross-replica KV hand-off pair (ISSUE 17). Minted eagerly
+        # (jax.jit is lazy — no trace happens until a transfer or the
+        # audit calls them) so the contract inventory and the audit's
+        # entry-point walk see the same surface on every engine.
+        self._export_fn = _make_page_export_fn(
+            contract_key=(), contract_owner=self, contract_budget=1)
+        self._capture_cost("engine.page_export", (), self._export_fn,
+                           self._null_export_args)
+        self._import_fn = _make_page_import_fn(
+            contract_key=(), contract_owner=self, contract_budget=1)
+        self._capture_cost("engine.page_import", (), self._import_fn,
+                           self._null_import_args)
+        # transfer inbox: export/import ops funneled onto the serve
+        # thread. The serve loop DONATES the page pools every round, so
+        # a router-thread jit on self._pools_* would race a deleted
+        # buffer; and the PrefixCache's documented thread contract puts
+        # every mutating call on the serve thread. _step_inner drains
+        # this deque at the top of each round; with no serve thread
+        # (manual-step tests, bench setup) the op is applied inline.
+        self._xfers: collections.deque = collections.deque()
+        # hand-off accounting (gated: exported via counters() only
+        # when a transfer has happened, keeping legacy JSON byte-
+        # compatible per the PR 15 pin)
+        self._transfers_out = 0
+        self._transfer_pages_out = 0
+        self._transfers_in = 0
+        self._transfer_pages_in = 0
         # whole-prompt prefill executables, LRU-bounded like the pp
         # decode cache (api.py _pp_decode_fn): prompt buckets are an
         # unbounded key space across traffic
@@ -1814,6 +1914,7 @@ class DecodeEngine:
         was nothing to do (idle)."""
         t0 = time.perf_counter()
         self._expire_deadlines()
+        did_xfer = self._apply_transfers()
         admitted_before = self._admitted
         t_adm = time.perf_counter()
         admit_prefilled = self._admit()
@@ -1860,7 +1961,7 @@ class DecodeEngine:
             if drafts:
                 self._spec_round(drafts, t0, admit_prefilled)
                 return True
-        return self._decode_round(t0, admit_prefilled)
+        return self._decode_round(t0, admit_prefilled) or did_xfer
 
     def _note_dispatch(self, name: str, key, dt_ms: float) -> None:
         """Round-granularity modeled-vs-measured accounting behind the
@@ -2369,11 +2470,299 @@ class DecodeEngine:
         assert self._prefix.cached_pages == 0
         self._prefix = PrefixCache(self.page_size)
 
+    # -- cross-replica KV page hand-off (ISSUE 17) -------------------------
+    # Disaggregated serving's transfer pair: a prefill replica exports
+    # the full-page prefix of a finished prompt as a self-contained
+    # host payload; a decode replica imports it into freshly allocated
+    # pages and registers the chain on its PrefixCache, so the next
+    # submit() of that prompt admits as a prefix HIT and decodes
+    # without prefilling. Both sides funnel through the transfer inbox
+    # (`_xfers`): the serve loop donates the page pools every round and
+    # the PrefixCache is serve-thread-only, so the actual pool work
+    # always runs on the serve thread (or inline when no serve thread
+    # exists — manual-step tests and bench setup).
+
+    def export_prefix(self, prompt: List[int]):
+        """Export the cached full-page prefix of `prompt` as a host
+        payload dict, or None when this engine's PrefixCache holds no
+        full page of it (never prefilled here, or already evicted).
+        The donor's pages stay registered and unreferenced — shipping
+        is a read, and LRU eviction reclaims them under pressure, so a
+        hand-off that dies on the receiving side needs no donor-side
+        cleanup at all."""
+        if self._prefix is None:
+            raise ValueError(
+                "export_prefix needs prefix_cache=True: the transfer "
+                "ships the cache's registered pages")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("export_prefix: empty prompt")
+        return self._run_transfer({"kind": "export", "prompt": prompt})
+
+    def import_prefix(self, payload):
+        """Splice an exported prefix payload into this engine's pool:
+        allocate pages (evicting idle cache entries if short), scatter
+        the payload rows in one jitted dispatch, and register the chain
+        on the PrefixCache refcounted exactly like locally prefilled
+        pages. Returns {'pages': shipped, 'registered': retained} on
+        success; False when the pool stayed short after eviction (the
+        caller falls back to prefilling locally). Geometry/dtype
+        mismatches (page size, kv dtype, layer shapes) raise
+        ValueError: splicing incompatible pages would poison decode."""
+        if self._prefix is None:
+            raise ValueError(
+                "import_prefix needs prefix_cache=True: transferred "
+                "pages land as cache entries")
+        self._check_payload(payload)
+        return self._run_transfer({"kind": "import", "payload": payload})
+
+    def _check_payload(self, payload) -> None:
+        """Receiver-side compatibility gate, on the CALLER's thread so
+        a bad payload fails fast instead of poisoning the serve loop."""
+        if not isinstance(payload, dict):
+            raise ValueError("import_prefix: payload must be the dict "
+                             "export_prefix produced")
+        n = int(payload.get("pages", 0))
+        ps = int(payload.get("page_size", 0))
+        toks = payload.get("tokens") or []
+        if n < 1 or n > self.max_pages_per_slot:
+            raise ValueError(
+                f"import_prefix: {n} pages outside [1, "
+                f"{self.max_pages_per_slot}] for this engine")
+        if ps != self.page_size:
+            raise ValueError(
+                f"import_prefix: payload page_size {ps} != engine "
+                f"page_size {self.page_size}")
+        if len(toks) != n * ps:
+            raise ValueError(
+                f"import_prefix: {len(toks)} prefix tokens for {n} "
+                f"pages of {ps}")
+        if str(payload.get("dtype")) != self.kv_pool_dtype():
+            raise ValueError(
+                f"import_prefix: payload kv dtype "
+                f"{payload.get('dtype')} != pool "
+                f"{self.kv_pool_dtype()} — a cross-dtype splice would "
+                f"decode garbage")
+        for name, pools in (("k", self._pools_k), ("v", self._pools_v),
+                            ("ks", self._pools_ks),
+                            ("vs", self._pools_vs)):
+            rows = payload.get(name) or []
+            if len(rows) != len(pools):
+                raise ValueError(
+                    f"import_prefix: {len(rows)} '{name}' layer blocks "
+                    f"vs {len(pools)} pools (int8 (data, scale) pairs "
+                    f"must travel together)")
+            for i, (r, p) in enumerate(zip(rows, pools)):
+                if tuple(r.shape[1:]) != tuple(p.shape[1:]):
+                    raise ValueError(
+                        f"import_prefix: '{name}' layer {i} page shape "
+                        f"{tuple(r.shape[1:])} != pool "
+                        f"{tuple(p.shape[1:])}")
+
+    def _run_transfer(self, op: dict):
+        """Apply `op` on the serve thread (inbox + wake + wait) or
+        inline when no serve loop is running. Waiters poll the engine's
+        liveness so a poisoned loop fails the hand-off instead of
+        hanging the router's orchestration thread."""
+        op["done"] = threading.Event()
+        op["result"] = None
+        op["error"] = None
+        with self._lock:
+            alive = (self._thread is not None and self._running
+                     and self._broken is None)
+            if alive:
+                self._xfers.append(op)
+                self._work.notify()
+        if not alive:
+            with self.mesh_scope():
+                self._apply_transfer(op)
+        else:
+            while not op["done"].wait(timeout=0.05):
+                if self._broken is not None or self._thread is None \
+                        or not self._thread.is_alive():
+                    # the loop died with the op possibly still queued;
+                    # _fail_all also sweeps the inbox, so either way:
+                    if not op["done"].is_set():
+                        raise RuntimeError(
+                            f"page transfer failed: engine "
+                            f"{'broken: ' + self._broken if self._broken else 'stopped'}")
+        if op["error"] is not None:
+            raise op["error"]
+        return op["result"]
+
+    def _apply_transfers(self) -> bool:
+        """Serve-thread inbox drain (top of every scheduler round)."""
+        did = False
+        while True:
+            with self._lock:
+                if not self._xfers:
+                    return did
+                op = self._xfers.popleft()
+            self._apply_transfer(op)
+            did = True
+
+    def _fail_transfers(self, msg: str) -> None:
+        while True:
+            with self._lock:
+                if not self._xfers:
+                    return
+                op = self._xfers.popleft()
+            op["error"] = RuntimeError(msg)
+            op["done"].set()
+
+    def _apply_transfer(self, op: dict) -> None:
+        try:
+            if op["kind"] == "export":
+                op["result"] = self._do_export(op["prompt"])
+            else:
+                op["result"] = self._do_import(op["payload"])
+        except Exception as e:  # noqa: BLE001 — the waiter re-raises;
+            # a transfer failure must never poison the serve loop
+            op["error"] = e
+        op["done"].set()
+
+    def _do_export(self, prompt: List[int]):
+        match = self._prefix.lookup(prompt)
+        n = match.full_pages
+        if n <= 0:
+            return None
+        # pin against eviction across the gather (serve-thread-local
+        # today, but the pin is what makes that an implementation
+        # detail rather than a liveness assumption)
+        self._prefix.acquire(match)
+        try:
+            ids = np.zeros(self.max_pages_per_slot, np.int32)
+            ids[:n] = match.pages[:n]
+            rows_k, rows_v, rows_ks, rows_vs = self._export_fn(
+                self._pools_k, self._pools_v, self._pools_ks,
+                self._pools_vs, self._dev(ids))
+
+            def host(rows):
+                return [np.asarray(r)[:n] for r in rows]
+
+            payload = {
+                "tokens": list(prompt[: n * self.page_size]),
+                "pages": n,
+                "page_size": self.page_size,
+                "dtype": self.kv_pool_dtype(),
+                "k": host(rows_k), "v": host(rows_v),
+                "ks": host(rows_ks), "vs": host(rows_vs),
+            }
+        finally:
+            self._prefix.unacquire(match)
+        self._transfers_out += 1
+        self._transfer_pages_out += n
+        self.recorder.record("xfer.export", pages=n,
+                             tokens=len(payload["tokens"]))
+        return payload
+
+    def _do_import(self, payload):
+        n = int(payload["pages"])
+        if n > len(self._free_pages):
+            self._free_pages.extend(
+                self._prefix.evict(n - len(self._free_pages)))
+        if n > len(self._free_pages):
+            return False  # pool full of LIVE pages: prefill locally
+        pages = [self._free_pages.pop() for _ in range(n)]
+        P = self.max_pages_per_slot
+        ids = np.zeros(P, np.int32)
+        ids[:n] = pages
+
+        def pad(rows, pools):
+            out = []
+            for r, p in zip(rows, pools):
+                block = np.zeros((P,) + tuple(p.shape[1:]),
+                                 np.dtype(p.dtype))
+                block[:n] = r
+                out.append(self._dev(block))
+            return tuple(out)
+
+        (self._pools_k, self._pools_v, self._pools_ks,
+         self._pools_vs) = self._import_fn(
+            self._pools_k, self._pools_v, self._pools_ks,
+            self._pools_vs, self._dev(ids),
+            pad(payload["k"], self._pools_k),
+            pad(payload["v"], self._pools_v),
+            pad(payload["ks"], self._pools_ks),
+            pad(payload["vs"], self._pools_vs))
+        rejected = self._prefix.insert_chain(
+            [int(t) for t in payload["tokens"]], pages)
+        self._free_pages.extend(rejected)
+        registered = n - len(rejected)
+        self._transfers_in += 1
+        self._transfer_pages_in += registered
+        self.recorder.record("xfer.import", pages=n,
+                             registered=registered)
+        return {"pages": n, "registered": registered}
+
+    # -- modeled backlog / admission (ISSUE 17) ----------------------------
+
+    def modeled_request_flops(self, prompt_tokens: int,
+                              gen_tokens: int, start: int = 0):
+        """Modeled device FLOPs to finish one request from cache length
+        `start`: the same analytic integral the per-request cost record
+        uses (linear 2N per computed token + attention 4*L*h per cached
+        position, integrated over context growth). None when the cost
+        registry is off — callers must fall back to occupancy signals,
+        not model against zero coefficients."""
+        if self.costs is None:
+            return None
+        final = prompt_tokens + gen_tokens
+        start = min(max(int(start), 0), final)
+        return (self._cost_fpt_linear * (final - start)
+                + 0.5 * self._cost_attn_coeff
+                * (float(final) ** 2 - float(start) ** 2))
+
+    def modeled_backlog_flops(self):
+        """Total modeled FLOPs queued on this engine: every queued
+        request priced from zero, every live slot priced from its
+        current cache length. The router's placement signal (ISSUE 17)
+        — replaces raw queue_depth + slots_busy, which weighs a 4k-token
+        prefill and a 12-token completion identically."""
+        if self.costs is None:
+            return None
+        total = 0.0
+        with self._lock:
+            work = [(len(r.prompt), r.tokens_to_generate, 0)
+                    for r in self._queue]
+            for i, s in enumerate(self._slots):
+                r = s.req
+                if r is not None:
+                    work.append((len(r.prompt), r.tokens_to_generate,
+                                 int(self._lengths[i])))
+        for plen, gen, start in work:
+            total += self.modeled_request_flops(plen, gen, start)
+        return total
+
+    def modeled_backlog_seconds(self):
+        """Modeled wall seconds to drain this engine's backlog at the
+        chip's roofline: backlog FLOPs / (peak FLOP/s x serving_tp).
+        None without a cost registry AND a credible chip spec — an SLO
+        decision against a guessed peak would be dishonest, so callers
+        degrade to the constant fallback instead."""
+        fl = self.modeled_backlog_flops()
+        if fl is None or self.chip is None:
+            return None
+        dtype = "int8" if self.quantize_weights else "bf16"
+        rate = self.chip.peak_flops_for(dtype) * max(self.serving_tp, 1)
+        return fl / max(rate, 1.0)
+
+    def retry_after_s(self) -> float:
+        """Honest Retry-After (ISSUE 17 satellite): the modeled drain
+        time of the current backlog, clamped to [1, 60] s; constant 1 s
+        when the cost registry is off (the pre-ISSUE-17 behaviour,
+        pinned by tests/test_server.py)."""
+        s = self.modeled_backlog_seconds()
+        if s is None:
+            return 1.0
+        return float(min(max(s, 1.0), 60.0))
+
     # -- background serve loop --------------------------------------------
 
     def _fail_all(self, msg: str):
         """Fail every queued and in-flight request (fatal step error or
         non-drain stop) so no waiter hangs on a dead engine."""
+        self._fail_transfers(msg)
         with self._lock:
             pending = list(self._queue)
             self._queue.clear()
@@ -2455,6 +2844,35 @@ class DecodeEngine:
                 self._pools_vs, self._dev(0, np.int32),
                 self._dev(0, np.int32))
 
+    def _null_xfer_ids(self):
+        # all-null ids: every row gathers/scatters the dead page 0 —
+        # the same idle-round idiom the other _null_*_args use
+        return self._dev(
+            np.zeros(self.max_pages_per_slot, np.int32))
+
+    def _null_payload_rows(self) -> tuple:
+        """Zero payload row blocks shaped like a full-width import —
+        one (max_pages_per_slot, ...) block per layer pool, pool
+        dtypes, on the engine's devices."""
+        P = self.max_pages_per_slot
+
+        def rows(pools):
+            return tuple(
+                self._dev(np.zeros((P,) + tuple(p.shape[1:]),
+                                   np.dtype(p.dtype))) for p in pools)
+
+        return (rows(self._pools_k), rows(self._pools_v),
+                rows(self._pools_ks), rows(self._pools_vs))
+
+    def _null_export_args(self) -> tuple:
+        return (self._pools_k, self._pools_v, self._pools_ks,
+                self._pools_vs, self._null_xfer_ids())
+
+    def _null_import_args(self) -> tuple:
+        rk, rv, rks, rvs = self._null_payload_rows()
+        return (self._pools_k, self._pools_v, self._pools_ks,
+                self._pools_vs, self._null_xfer_ids(), rk, rv, rks, rvs)
+
     def warmup(self):
         """Pre-trace every step executable the configured buckets can
         reach — the pow2 decode-scan horizons and (chunked mode) the
@@ -2483,6 +2901,16 @@ class DecodeEngine:
             (_, _, _, _, _, _, self._pools_k, self._pools_v,
              self._pools_ks, self._pools_vs) = \
                 self._spec_fn(w, True)(*self._null_spec_args(w))
+        if self._prefix is not None:
+            # hand-off pair (ISSUE 17): the first cross-replica
+            # transfer must not eat a compile stall mid-burst. The
+            # null import scatters zero rows into the dead null page
+            # only (all-null ids), so like every other warmup dispatch
+            # it is invisible to traffic; pools are reassigned from
+            # the donated outputs.
+            self._export_fn(*self._null_export_args())
+            (self._pools_k, self._pools_v, self._pools_ks,
+             self._pools_vs) = self._import_fn(*self._null_import_args())
 
     def audit_entry_points(self):
         """(contract name, jitted fn, example args) for every jitted
@@ -2514,6 +2942,10 @@ class DecodeEngine:
                         self._null_spec_args(w)))
         out.append(("engine.page_copy", self._copy_fn,
                     self._null_copy_args()))
+        out.append(("engine.page_export", self._export_fn,
+                    self._null_export_args()))
+        out.append(("engine.page_import", self._import_fn,
+                    self._null_import_args()))
         return out
 
     def start(self):
@@ -2603,6 +3035,9 @@ class DecodeEngine:
             self._work.notify_all()
         self._thread.join()
         self._thread = None
+        # a transfer enqueued after the loop's last drain would hang
+        # its waiter forever — sweep the inbox now the loop is gone
+        self._fail_transfers("engine stopped")
         self._stop_profile()  # an interrupted capture still flushes
         if self.trace_dir:
             import os as _os
@@ -2757,6 +3192,14 @@ class DecodeEngine:
             # the legacy one
             out["serve_perf_regressions"] = self._sentinel.trips
             out["serve_perf_bad_rounds"] = self._sentinel.bad_total
+        if (self._transfers_out or self._transfers_in):
+            # cross-replica hand-off gauges (ISSUE 17): present only
+            # once this engine has actually shipped/received pages, so
+            # every non-disaggregated deployment keeps the legacy JSON
+            out["serve_transfers_out"] = self._transfers_out
+            out["serve_transfer_pages_out"] = self._transfer_pages_out
+            out["serve_transfers_in"] = self._transfers_in
+            out["serve_transfer_pages_in"] = self._transfer_pages_in
         return out
 
     def export_gauges(self, timers=None):

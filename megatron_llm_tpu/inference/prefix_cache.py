@@ -231,6 +231,30 @@ class PrefixCache:
         self.inserted_pages += 1
         return True
 
+    def insert_chain(self, prefix_tokens: List[int],
+                     pages: List[int]) -> List[int]:
+        """Register a TRANSFERRED page chain (cross-replica KV hand-off,
+        ISSUE 17): page `pages[d-1]` holds the KV for prefix depth `d`.
+        Unlike a prefilling slot's insert(), no slot references these
+        pages — each successful insert's registering reference is
+        dropped immediately, so the chain lands registered-but-
+        unreferenced: the next lookup maps it for free, and eviction
+        may reclaim it under pool pressure like any idle entry.
+        Returns the pages the cache did NOT retain (prefix already
+        cached here, or chain broken by concurrent eviction) — the
+        caller free-lists those; their KV is bitwise identical to the
+        retained entry's, so dropping duplicates loses nothing."""
+        ps = self.page_size
+        assert len(prefix_tokens) == len(pages) * ps and pages
+        rejected: List[int] = []
+        for d, pg in enumerate(pages, start=1):
+            if self.insert(prefix_tokens[: d * ps], pg):
+                retained = self.release(pg)
+                assert retained  # fresh entry: registered, now idle
+            else:
+                rejected.append(pg)
+        return rejected
+
     def owns(self, page: int) -> bool:
         return page in self._ref or page in self._by_page
 

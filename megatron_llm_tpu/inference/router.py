@@ -73,6 +73,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import queue as queue_mod
 import random
 import threading
 import time
@@ -80,8 +81,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["EngineReplica", "FleetUnavailable", "HTTPReplica",
-           "PrefixAffinityIndex", "ReplicaRouter"]
+__all__ = ["BacklogExceeded", "EngineReplica", "FleetUnavailable",
+           "HTTPReplica", "PrefixAffinityIndex", "ReplicaRouter"]
 
 
 def _queue_full_base():
@@ -98,6 +99,19 @@ class FleetUnavailable(_queue_full_base()):
     surface as a 500, which load balancers treat as a hard server
     fault and eject, exactly when the fleet is one cooldown away from
     recovering (GET /health reports the same transient state)."""
+
+
+class BacklogExceeded(FleetUnavailable):
+    """SLO-aware admission rejection (ISSUE 17): the MODELED drain time
+    of every eligible replica's backlog exceeds the router's TTFT
+    budget, so admitting would only manufacture a guaranteed SLO miss.
+    A QueueFull by inheritance — the HTTP layer's existing 503 path —
+    but the Retry-After it ships is the modeled drain estimate, not a
+    constant: `retry_after_s` carries it."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class PrefixAffinityIndex:
@@ -198,6 +212,14 @@ class EngineReplica:
     def cancel(self, req):
         self.engine.cancel(req)
 
+    # -- cross-replica KV hand-off (ISSUE 17) ------------------------------
+
+    def export_prefix(self, prompt):
+        return self.engine.export_prefix(prompt)
+
+    def import_prefix(self, payload):
+        return self.engine.import_prefix(payload)
+
     # -- health / load (the /health + /metrics feed) -----------------------
 
     def health(self) -> dict:
@@ -206,6 +228,18 @@ class EngineReplica:
     def load(self) -> int:
         h = self.engine.health()
         return h["queue_depth"] + h["slots_busy"]
+
+    def modeled_backlog_flops(self):
+        """The engine's modeled-FLOPs backlog (ISSUE 17) — None when
+        its cost registry is off, and the router then falls back to
+        the occupancy load() signal for the whole fleet."""
+        return self.engine.modeled_backlog_flops()
+
+    def modeled_backlog_s(self):
+        return self.engine.modeled_backlog_seconds()
+
+    def retry_after_s(self) -> float:
+        return self.engine.retry_after_s()
 
     def counters(self) -> dict:
         return self.engine.counters()
@@ -432,6 +466,27 @@ class HTTPReplica:
     def cancel(self, req):
         _logger.warning("HTTPReplica cannot cancel a remote request")
 
+    # -- ISSUE 17 surfaces: not proxied over the wire ----------------------
+    # A remote replica's modeled backlog and page pools are not
+    # reachable through PUT /api; the router treats None/None/False as
+    # "fall back to occupancy load / direct dispatch", so a mixed
+    # fleet degrades to PR-14 behaviour instead of failing.
+
+    def modeled_backlog_flops(self):
+        return None
+
+    def modeled_backlog_s(self):
+        return None
+
+    def retry_after_s(self):
+        return None
+
+    def export_prefix(self, prompt):
+        return None
+
+    def import_prefix(self, payload):
+        return False
+
     def start(self):
         pass
 
@@ -461,6 +516,79 @@ class _HTTPResult:
         return self._payload, None
 
 
+class _HandoffRequest:
+    """EngineRequest-shaped handle for one TWO-STAGE dispatch (prefill
+    replica -> page transfer -> decode replica, ISSUE 17). The caller
+    gets it back immediately; a router orchestration thread runs the
+    stages and attaches the decode replica's real EngineRequest when
+    the final submit lands. Timestamps are absolute perf_counter
+    values like EngineRequest's, with `t_submit` stamped at ROUTER
+    submit time — so TTFT measured on this handle honestly includes
+    the prefill stage and the page transfer, not just the decode
+    replica's queue wait."""
+
+    def __init__(self, prompt, tokens_to_generate, stream: bool = False):
+        self.prompt = list(prompt)
+        self.tokens_to_generate = int(tokens_to_generate)
+        self.rid = -1  # until attach: no engine has admitted it yet
+        self.replica_id: Optional[int] = None
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self.timed_out = False
+        self.cancelled = False
+        self.inner = None  # the decode replica's EngineRequest
+        self.tokens: list = []
+        self.log_probs: list = []
+        self.return_log_probs = False
+        self.stream_q = queue_mod.SimpleQueue() if stream else None
+        self.t_submit = time.perf_counter()
+        self.t_first = 0.0
+        self.t_done = 0.0
+
+    def attach(self, inner) -> None:
+        self.inner = inner
+        self.rid = getattr(inner, "rid", -1)
+        self.replica_id = getattr(inner, "replica_id", None)
+
+    def finalize(self, inner) -> None:
+        """Mirror the finished inner request's outcome onto the handle
+        the caller holds, then release waiters."""
+        self.tokens = list(getattr(inner, "tokens", []) or [])
+        self.log_probs = list(getattr(inner, "log_probs", []) or [])
+        self.return_log_probs = bool(
+            getattr(inner, "return_log_probs", False))
+        self.error = getattr(inner, "error", None)
+        self.timed_out = bool(getattr(inner, "timed_out", False))
+        # t_first may already be stamped at prefill-stage completion
+        # (greedy hand-off: the donor's 1-token run IS the first token
+        # of the continuation — the decode replica regenerates it
+        # bitwise-identically) — keep the earlier, truthful timestamp
+        if not self.t_first:
+            self.t_first = getattr(inner, "t_first", 0.0) or 0.0
+        self.t_done = getattr(inner, "t_done", 0.0) or time.perf_counter()
+        self.done.set()
+
+    def fail(self, msg: str, timed_out: bool = False) -> None:
+        self.error = msg
+        self.timed_out = timed_out
+        self.t_done = time.perf_counter()
+        if self.stream_q is not None:
+            self.stream_q.put(None)  # close any SSE consumer
+        self.done.set()
+
+    def result(self, timeout: Optional[float] = None):
+        """EngineRequest.result contract: (tokens, log_probs), raising
+        TimeoutError/RuntimeError exactly like a direct dispatch."""
+        if not self.done.wait(timeout):
+            raise TimeoutError("hand-off request still running")
+        if self.error is not None:
+            if self.timed_out:
+                raise TimeoutError(self.error)
+            raise RuntimeError(self.error)
+        return self.tokens, (self.log_probs if self.return_log_probs
+                             else None)
+
+
 class ReplicaRouter:
     """Prefix-affinity dispatcher over N replicas (module docstring).
 
@@ -475,13 +603,54 @@ class ReplicaRouter:
     - `unhealthy_cooldown_s`: how long a replica marked down at
       submit time stays out of rotation before the next health
       re-probe may readmit it.
+
+    Disaggregated two-stage mode (ISSUE 17, docs/GUIDE.md
+    "Disaggregated serving"): pass `prefill_replicas=` +
+    `decode_replicas=` INSTEAD of `replicas=`. Long prompts (>=
+    `disagg_min_prompt_pages` full pages) prefill on the
+    least-modeled-backlog prefill replica, their finished KV pages
+    ship to the least-backlogged decode replica
+    (export_prefix/import_prefix), and the full request then admits
+    there as a prefix HIT — decode replicas never eat long mixed
+    rounds. Short prompts take the direct path onto decode replicas
+    unchanged. `ttft_slo_s` arms modeled-backlog admission: when every
+    eligible replica's modeled drain time exceeds the budget, submit
+    raises BacklogExceeded (a 503) carrying the modeled Retry-After.
     """
 
-    def __init__(self, replicas: List, *, affinity: bool = True,
+    def __init__(self, replicas: Optional[List] = None, *,
+                 affinity: bool = True,
                  fallback: str = "least_loaded",
                  index_entries: int = 8192,
                  unhealthy_cooldown_s: float = 1.0,
-                 rng_seed: int = 0):
+                 rng_seed: int = 0,
+                 prefill_replicas: Optional[List] = None,
+                 decode_replicas: Optional[List] = None,
+                 disagg_min_prompt_pages: int = 2,
+                 ttft_slo_s: Optional[float] = None,
+                 handoff_timeout_s: float = 600.0):
+        if (prefill_replicas is None) != (decode_replicas is None):
+            raise ValueError(
+                "disaggregated mode takes BOTH prefill_replicas= and "
+                "decode_replicas= (a fleet with only one role cannot "
+                "hand pages off)")
+        self.disagg = prefill_replicas is not None
+        if self.disagg:
+            if replicas:
+                raise ValueError(
+                    "pass either replicas= (symmetric fleet) or the "
+                    "prefill_replicas=/decode_replicas= pair, not both")
+            if not prefill_replicas or not decode_replicas:
+                raise ValueError(
+                    "disaggregated mode needs at least one prefill AND "
+                    "one decode replica")
+            self._prefill_ids = [r.replica_id for r in prefill_replicas]
+            self._decode_ids = [r.replica_id for r in decode_replicas]
+            replicas = list(prefill_replicas) + list(decode_replicas)
+        else:
+            replicas = list(replicas or [])
+            self._prefill_ids = []
+            self._decode_ids = [r.replica_id for r in replicas]
         if not replicas:
             raise ValueError("a router needs at least one replica")
         if fallback not in ("least_loaded", "random"):
@@ -506,6 +675,9 @@ class ReplicaRouter:
         self._index = PrefixAffinityIndex(self.page_size, index_entries)
         self._rng = random.Random(rng_seed)
         self.unhealthy_cooldown_s = unhealthy_cooldown_s
+        self.disagg_min_prompt_pages = max(int(disagg_min_prompt_pages), 1)
+        self.ttft_slo_s = ttft_slo_s
+        self.handoff_timeout_s = handoff_timeout_s
         self._down_until: Dict[int, float] = {}  # replica_id -> monotonic
         self._lock = threading.Lock()  # index + policy state (submit
         # can be called from N HTTP handler threads concurrently)
@@ -519,20 +691,34 @@ class ReplicaRouter:
         self._rejected = 0
         self._per_replica: Dict[int, int] = {r.replica_id: 0
                                              for r in replicas}
+        # ISSUE 17 accounting — exported GATED on disagg/SLO mode so
+        # the symmetric fleet's /metrics JSON stays byte-compatible
+        self._prefill_dispatches = 0
+        self._transfer_pages = 0
+        self._transfer_ms = 0.0
+        self._slo_rejected = 0
+        # placement-decision trail (reproducibility: every routing
+        # choice alongside the modeled backlogs it was made from)
+        self._decisions: collections.deque = collections.deque(
+            maxlen=256)
 
     # -- health ------------------------------------------------------------
 
-    def _probe(self) -> Tuple[List[int], Dict[int, int]]:
-        """(healthy replica ids, their load snapshot). Runs OUTSIDE
-        the router lock on purpose: for HTTPReplica fleets health/load
-        are network probes (seconds of blocking I/O on a sick host),
-        and one hung replica must never stall every other handler
-        thread's submit behind the lock. `_down_until` reads here are
-        unsynchronized — a stale read only delays rotation changes by
-        one dispatch, which the advisory contract absorbs."""
+    def _probe(self) -> Tuple[List[int], Dict[int, int], Dict[int, float]]:
+        """(healthy replica ids, occupancy loads, modeled-FLOPs
+        backlogs). Runs OUTSIDE the router lock on purpose: for
+        HTTPReplica fleets health/load are network probes (seconds of
+        blocking I/O on a sick host), and one hung replica must never
+        stall every other handler thread's submit behind the lock.
+        `_down_until` reads here are unsynchronized — a stale read only
+        delays rotation changes by one dispatch, which the advisory
+        contract absorbs. The modeled backlog (ISSUE 17) is absent for
+        replicas without a cost registry (and for remote replicas);
+        ordering only trusts it when EVERY candidate reports one."""
         now = time.monotonic()
         healthy: List[int] = []
         loads: Dict[int, int] = {}
+        mloads: Dict[int, float] = {}
         for rep in self.replicas:
             rid = rep.replica_id
             if self._down_until.get(rid, 0.0) > now:
@@ -541,9 +727,17 @@ class ReplicaRouter:
             if h["alive"] and h["broken"] is None:
                 healthy.append(rid)
                 loads[rid] = h["queue_depth"] + h["slots_busy"]
+                fn = getattr(rep, "modeled_backlog_flops", None)
+                if fn is not None:
+                    try:
+                        m = fn()
+                    except Exception:  # noqa: BLE001 — advisory signal
+                        m = None
+                    if m is not None:
+                        mloads[rid] = float(m)
             else:
                 self._mark_down(rid, h["broken"] or "serve loop dead")
-        return healthy, loads
+        return healthy, loads, mloads
 
     def _mark_down(self, rid: int, why) -> None:
         """Takes the router lock itself — callers must NOT hold it."""
@@ -558,8 +752,20 @@ class ReplicaRouter:
 
     # -- dispatch ----------------------------------------------------------
 
-    def _pick(self, prompt, healthy: List[int],
-              loads: Dict[int, int]) -> List[int]:
+    @staticmethod
+    def _order_by_backlog(ids: List[int], loads: Dict[int, int],
+                          mloads: Dict[int, float]) -> List[int]:
+        """Least-backlogged-first ordering: by modeled FLOPs when EVERY
+        candidate reports them (a 4k-token prefill then outweighs ten
+        12-token completions, which raw occupancy cannot see), by
+        queue_depth + slots_busy otherwise — mixing modeled and
+        occupancy numbers would compare incommensurable units."""
+        if ids and all(rid in mloads for rid in ids):
+            return sorted(ids, key=lambda rid: (mloads[rid], rid))
+        return sorted(ids, key=lambda rid: (loads.get(rid, 0), rid))
+
+    def _pick(self, prompt, healthy: List[int], loads: Dict[int, int],
+              mloads: Dict[int, float]) -> List[int]:
         """Candidate replica ids in dispatch order: affinity hit first
         (when it is healthy), then the fallback-policy ordering of the
         rest — the failover path walks this list. Called under the
@@ -575,19 +781,58 @@ class ReplicaRouter:
         if self.fallback == "random":
             self._rng.shuffle(rest)
         else:
-            rest.sort(key=lambda rid: (loads.get(rid, 0), rid))
+            rest = self._order_by_backlog(rest, loads, mloads)
         return order + rest
+
+    def _admission_gate(self, cands: List[int]) -> None:
+        """SLO-aware admission (ISSUE 17): with `ttft_slo_s` set,
+        reject when the MODELED drain time of every eligible replica
+        exceeds the budget — the request would be born an SLO miss.
+        Stays open when any candidate cannot model its backlog (no
+        cost registry / no chip spec / remote): an occupancy number is
+        not a drain time, and rejecting on a guess would be the
+        dishonest Retry-After this satellite exists to remove."""
+        if self.ttft_slo_s is None:
+            return
+        secs: List[float] = []
+        for rid in cands:
+            fn = getattr(self._by_id[rid], "modeled_backlog_s", None)
+            s = None
+            if fn is not None:
+                try:
+                    s = fn()
+                except Exception:  # noqa: BLE001 — advisory signal
+                    s = None
+            if s is None:
+                return
+            secs.append(float(s))
+        if not secs or min(secs) <= self.ttft_slo_s:
+            return
+        best = min(secs)
+        retry = float(min(max(best, 1.0), 60.0))
+        with self._lock:
+            self._rejected += 1
+            self._slo_rejected += 1
+            self._decisions.append({
+                "path": "slo_reject", "modeled_backlog_s": round(best, 4),
+                "ttft_slo_s": self.ttft_slo_s,
+                "retry_after_s": retry})
+        raise BacklogExceeded(
+            f"router: modeled backlog {best:.2f}s exceeds the "
+            f"ttft_slo_s={self.ttft_slo_s}s budget on every eligible "
+            f"replica — admitting now would guarantee an SLO miss; "
+            f"retry in {retry:.0f}s", retry_after_s=retry)
 
     def submit(self, prompt, tokens_to_generate, **kw):
         """Dispatch one request; the returned handle is the chosen
         engine's own EngineRequest (rid + replica_id identify it
-        fleet-wide). Raises the last replica error — QueueFull only
-        when EVERY healthy replica's queue is full, FleetUnavailable
-        (a QueueFull: the HTTP layer's 503 + Retry-After) when no
-        replica is healthy at all."""
-        from megatron_llm_tpu.inference.engine import QueueFull
-
-        healthy, loads = self._probe()  # blocking I/O stays unlocked
+        fleet-wide) — or, on the disaggregated two-stage path, a
+        _HandoffRequest proxy with the same result()/stream contract.
+        Raises the last replica error — QueueFull only when EVERY
+        healthy replica's queue is full, FleetUnavailable (a QueueFull:
+        the HTTP layer's 503 + Retry-After) when no replica is healthy
+        at all, BacklogExceeded when modeled admission rejects."""
+        healthy, loads, mloads = self._probe()  # blocking I/O unlocked
         if not healthy:
             with self._lock:
                 self._rejected += 1
@@ -595,8 +840,34 @@ class ReplicaRouter:
                 "router: no healthy replica (all poisoned/stopped "
                 "or cooling down) — the fleet cannot take traffic; "
                 "retry after the cooldown")
+        prompt = list(prompt)
+        if not self.disagg:
+            self._admission_gate(healthy)
+            return self._submit_direct(prompt, tokens_to_generate, kw,
+                                       healthy, loads, mloads)
+        pre = [r for r in self._prefill_ids if r in healthy]
+        # short prompts stay on decode replicas; with every decode
+        # replica down the fleet degrades to whatever is healthy
+        # (a prefill replica is a full engine) rather than 503ing
+        dec = [r for r in self._decode_ids if r in healthy] or healthy
+        self._admission_gate(dec)
+        pages = (len(prompt) - 1) // self.page_size
+        if (pre and pages >= self.disagg_min_prompt_pages
+                and not kw.get("return_log_probs")):
+            # return_log_probs stays direct: a transferred-prefix HIT
+            # skips those positions' logits entirely, and the two-stage
+            # win is TTFT on long-prompt GENERATION traffic
+            return self._submit_two_stage(prompt, tokens_to_generate,
+                                          kw)
+        return self._submit_direct(prompt, tokens_to_generate, kw,
+                                   dec, loads, mloads)
+
+    def _submit_direct(self, prompt, tokens_to_generate, kw,
+                       cands: List[int], loads, mloads):
+        from megatron_llm_tpu.inference.engine import QueueFull
+
         with self._lock:
-            order = self._pick(list(prompt), healthy, loads)
+            order = self._pick(prompt, cands, loads, mloads)
             self._dispatches += 1
         last_err: Optional[BaseException] = None
         for i, rid in enumerate(order):
@@ -622,14 +893,177 @@ class ReplicaRouter:
             with self._lock:
                 self._per_replica[rid] += 1
                 if self.affinity:
-                    self._index.register(list(prompt), rid)
+                    self._index.register(prompt, rid)
+                if self.disagg or self.ttft_slo_s is not None:
+                    self._decisions.append({
+                        "path": "direct", "replica": rid,
+                        "prompt_tokens": len(prompt),
+                        "loads": dict(loads),
+                        "modeled_flops": dict(mloads)})
             return req
         with self._lock:
             self._rejected += 1
         raise last_err if last_err is not None else RuntimeError(
             "router: dispatch failed with no replica error")
 
+    # -- two-stage (prefill -> transfer -> decode) dispatch ----------------
+
+    def _submit_two_stage(self, prompt, tokens_to_generate, kw):
+        proxy = _HandoffRequest(prompt, tokens_to_generate,
+                                stream=bool(kw.get("stream")))
+        with self._lock:
+            self._dispatches += 1
+        threading.Thread(
+            target=self._run_two_stage,
+            args=(proxy, prompt, tokens_to_generate, dict(kw)),
+            daemon=True).start()
+        return proxy
+
+    def _run_two_stage(self, proxy, prompt, tokens_to_generate, kw):
+        try:
+            self._two_stage_inner(proxy, prompt, tokens_to_generate, kw)
+        except BaseException as e:  # noqa: BLE001 — the caller holds
+            # only the proxy; an unreported stage failure would hang it
+            proxy.fail(f"two-stage dispatch failed: {e!r}",
+                       timed_out=isinstance(e, TimeoutError))
+
+    def _two_stage_inner(self, proxy, prompt, tokens_to_generate, kw):
+        from megatron_llm_tpu.inference.engine import QueueFull
+
+        # stage 1: full-prompt chunked prefill on the least-backlogged
+        # prefill replica. A greedy 1-token run prefills the whole
+        # prompt and registers its full pages on the donor's
+        # PrefixCache; the single generated token never lands in a
+        # registered page, so the export is exactly the prompt's
+        # full-page prefix.
+        healthy, loads, mloads = self._probe()
+        payload, pre_rid = None, None
+        t_x0 = None
+        pre_ids = [r for r in self._prefill_ids if r in healthy]
+        if pre_ids and not proxy.cancelled:
+            pre_rid = self._order_by_backlog(pre_ids, loads, mloads)[0]
+            pre = self._by_id[pre_rid]
+            try:
+                pre_req = pre.submit(
+                    prompt, 1, top_k=1, seed=0,
+                    use_eod_for_early_termination=False,
+                    deadline_s=kw.get("deadline_s"))
+                pre_req.result(timeout=self.handoff_timeout_s)
+                t_x0 = time.perf_counter()
+                payload = pre.export_prefix(prompt)
+            except Exception as e:  # noqa: BLE001 — donor trouble
+                # never fails the request: fall back to direct prefill
+                # on the decode replica (the symmetric-path behaviour)
+                if not isinstance(e, (QueueFull, TimeoutError)):
+                    self._mark_down(pre_rid, repr(e))
+                payload, t_x0 = None, None
+            else:
+                with self._lock:
+                    self._prefill_dispatches += 1
+                    self._per_replica[pre_rid] += 1
+                # for a greedy request the donor's 1-token run already
+                # produced the continuation's first token (the decode
+                # replica regenerates it bitwise-identically off the
+                # transferred pages), so TTFT is prefill-stage
+                # completion — stamp it now, before splice + resubmit
+                if kw.get("top_k") == 1:
+                    proxy.t_first = getattr(pre_req, "t_first", 0.0) or 0.0
+
+        # stage 2 + 3: splice the pages into the least-backlogged
+        # decode replica, then submit the full request there — the
+        # transferred chain admits as a prefix HIT, so the decode
+        # replica prefills nothing (or, on fallback, everything: the
+        # request is correct either way, only slower).
+        healthy, loads, mloads = self._probe()
+        dec_ids = [r for r in self._decode_ids if r in healthy] or healthy
+        if not dec_ids:
+            with self._lock:
+                self._rejected += 1
+            raise FleetUnavailable(
+                "router: no decode replica healthy for the hand-off")
+        order = self._order_by_backlog(dec_ids, loads, mloads)
+        last_err: Optional[BaseException] = None
+        for i, rid in enumerate(order):
+            rep = self._by_id[rid]
+            moved = 0
+            try:
+                if payload is not None and not proxy.cancelled:
+                    res = rep.import_prefix(payload)
+                    if res:
+                        moved = int(res.get("pages", 0))
+                req = rep.submit(prompt, tokens_to_generate, **kw)
+            except QueueFull as e:
+                last_err = e
+                with self._lock:
+                    self._failovers += 1 if i + 1 < len(order) else 0
+                continue
+            except ValueError:
+                raise
+            except Exception as e:  # noqa: BLE001 — replica died
+                # mid-transfer: mark it down and fail over. The donor
+                # needs NO cleanup — its pages stayed registered and
+                # unreferenced, reclaimable by its own LRU eviction.
+                last_err = e
+                self._mark_down(rid, repr(e))
+                with self._lock:
+                    self._failovers += 1 if i + 1 < len(order) else 0
+                continue
+            xfer_ms = (0.0 if t_x0 is None
+                       else (time.perf_counter() - t_x0) * 1e3)
+            with self._lock:
+                self._per_replica[rid] += 1
+                if self.affinity:
+                    # future same-prefix prompts route straight to the
+                    # replica now holding the transferred pages
+                    self._index.register(prompt, rid)
+                if moved:
+                    self._transfer_pages += moved
+                    self._transfer_ms += xfer_ms
+                self._decisions.append({
+                    "path": "two_stage", "prefill": pre_rid,
+                    "decode": rid, "pages": moved,
+                    "prompt_tokens": len(prompt),
+                    "loads": dict(loads),
+                    "modeled_flops": dict(mloads)})
+            self._finish_two_stage(proxy, rep, req)
+            return
+        with self._lock:
+            self._rejected += 1
+        raise last_err if last_err is not None else FleetUnavailable(
+            "router: no decode replica accepted the hand-off")
+
+    def _finish_two_stage(self, proxy, rep, req) -> None:
+        """Wire the decode replica's live request back onto the proxy:
+        attach ids, honour a pre-attach cancel, pump the token stream,
+        and mirror the final outcome."""
+        proxy.attach(req)
+        if proxy.cancelled:
+            try:
+                rep.cancel(req)
+            except Exception:  # noqa: BLE001
+                pass
+        inner_q = getattr(req, "stream_q", None)
+        if proxy.stream_q is not None and inner_q is not None:
+            while True:
+                try:
+                    tok = inner_q.get(timeout=self.handoff_timeout_s)
+                except queue_mod.Empty:
+                    break  # engine hung: finalize below reports it
+                proxy.stream_q.put(tok)
+                if tok is None:
+                    break
+        done = getattr(req, "done", None)
+        if done is not None:
+            done.wait(timeout=self.handoff_timeout_s)
+        proxy.finalize(req)
+
     def cancel(self, req) -> None:
+        if isinstance(req, _HandoffRequest):
+            req.cancelled = True  # pre-attach: the orchestration
+            # thread sees it and cancels on arrival
+            if req.inner is None:
+                return
+            req = req.inner
         rep = self._by_id.get(getattr(req, "replica_id", None))
         if rep is None:
             _logger.warning("router.cancel: request %r names no known "
@@ -642,7 +1076,7 @@ class ReplicaRouter:
     def router_stats(self) -> dict:
         with self._lock:
             d = max(self._dispatches, 1)
-            return {
+            out = {
                 "router_replicas": len(self.replicas),
                 "router_affinity": self.affinity,
                 "router_fallback": self.fallback,
@@ -656,6 +1090,45 @@ class ReplicaRouter:
                 "router_index_entries": len(self._index),
                 "router_per_replica_dispatches": dict(self._per_replica),
             }
+            if self.disagg:
+                # ISSUE 17: gated on disaggregated mode so symmetric
+                # fleets keep the byte-compatible legacy /metrics JSON
+                out["router_prefill_replicas"] = len(self._prefill_ids)
+                out["router_decode_replicas"] = len(self._decode_ids)
+                out["serve_prefill_replica"] = self._prefill_dispatches
+                out["serve_transfer_pages"] = self._transfer_pages
+                out["serve_transfer_ms"] = round(self._transfer_ms, 2)
+            if self.ttft_slo_s is not None:
+                out["router_slo_rejected"] = self._slo_rejected
+            return out
+
+    def decision_log(self) -> list:
+        """The recent placement decisions (bounded ring): path taken,
+        chosen prefill/decode replicas, pages shipped, and the
+        loads/modeled-FLOPs snapshot each choice was made from — the
+        ISSUE 17 reproducibility trail (the bench re-derives the
+        routing from exactly these records)."""
+        with self._lock:
+            return [dict(dec) for dec in self._decisions]
+
+    def retry_after_s(self) -> float:
+        """Honest fleet Retry-After (ISSUE 17 satellite): the SOONEST
+        any replica's modeled backlog drains, clamped to [1, 60] s;
+        constant 1 s when no replica can model (the legacy header)."""
+        vals: List[float] = []
+        for rep in self.replicas:
+            fn = getattr(rep, "retry_after_s", None)
+            if fn is None:
+                continue
+            try:
+                v = fn()
+            except Exception:  # noqa: BLE001 — advisory
+                v = None
+            if v is not None:
+                vals.append(float(v))
+        if not vals:
+            return 1.0
+        return float(min(max(min(vals), 1.0), 60.0))
 
     def counters(self) -> dict:
         """Fleet /metrics: router dispatch stats + additive engine
@@ -730,10 +1203,14 @@ class ReplicaRouter:
         return render_prometheus(counters, self.histograms())
 
     def flight_record(self) -> dict:
-        return {"reason": "on-demand",
-                "router": self.router_stats(),
-                "replicas": {r.replica_id: r.flight_record()
-                             for r in self.replicas}}
+        out = {"reason": "on-demand",
+               "router": self.router_stats(),
+               "replicas": {r.replica_id: r.flight_record()
+                            for r in self.replicas}}
+        if self.disagg or self.ttft_slo_s is not None:
+            # gated like the counters: pre-ISSUE-17 dumps keep their shape
+            out["decisions"] = self.decision_log()
+        return out
 
     def request_profile(self, rounds: int,
                         trace_dir: Optional[str] = None,

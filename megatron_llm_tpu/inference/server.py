@@ -486,9 +486,13 @@ class MegatronGenerate:
         # the SSE `id:` correlation key (ISSUE 13/14): rid alone on a
         # standalone engine (the pinned legacy surface); "replica-rid"
         # once the serving engine is a tagged replica behind the
-        # router, so N replicas' ids stay distinguishable client-side
-        sse_id = (req.rid if getattr(req, "replica_id", None) is None
-                  else f"{req.replica_id}-{req.rid}")
+        # router, so N replicas' ids stay distinguishable client-side.
+        # Resolved PER EVENT, not at submit: a two-stage hand-off
+        # proxy (ISSUE 17) has no engine identity until the decode
+        # replica attaches — and its first token only flows after that
+        def sse_id():
+            return (req.rid if getattr(req, "replica_id", None) is None
+                    else f"{req.replica_id}-{req.rid}")
         out_ids = []
         # INCREMENTAL detokenization over a bounded tail window: decode
         # the pending tokens and emit the suffix delta — a per-token
@@ -539,7 +543,7 @@ class MegatronGenerate:
                         while win_emitted.endswith("�"):
                             win_emitted = win_emitted[:-1]
                 write_event({"token": int(t), "text": delta},
-                            rid=sse_id)
+                            rid=sse_id())
         except _queue.Empty:
             # stalled engine: reclaim the slot and tell the client
             # before closing — an EOF with no done event looks like a
@@ -551,7 +555,7 @@ class MegatronGenerate:
                 write_event({"done": True, "rid": req.rid,
                              "error": "timed out waiting for the "
                                       "engine; request cancelled"},
-                            rid=sse_id)
+                            rid=sse_id())
             except Exception:
                 pass
             return None
@@ -569,7 +573,7 @@ class MegatronGenerate:
             final = {"done": True, "rid": req.rid, "error": req.error}
         else:
             final["text"] = tok.detokenize(ids + out_ids)
-        write_event(final, rid=sse_id)
+        write_event(final, rid=sse_id())
         return None
 
 
@@ -774,10 +778,24 @@ class _Handler(BaseHTTPRequestHandler):
         if status in (503, 504):
             # overload (busy device / full queue / deadline shed): tell
             # clients when to come back instead of letting them hammer
-            # the socket
-            self.send_header("Retry-After", "1")
+            # the socket. With a cost registry on, the engine/router
+            # models its backlog drain time (ISSUE 17) — an honest
+            # estimate clamped to [1, 60] s; without one this stays the
+            # legacy constant 1 s (tests/test_server.py pins it).
+            self.send_header("Retry-After", self._retry_after())
         self.end_headers()
         self.wfile.write(data)
+
+    def _retry_after(self) -> str:
+        try:
+            eng = getattr(self.generator, "engine", None)
+            fn = getattr(eng, "retry_after_s", None)
+            if fn is not None:
+                return str(max(int(round(float(fn()))), 1))
+        except Exception:  # noqa: BLE001 — the header is advisory; a
+            # modeling hiccup must never turn a 503 into a 500
+            pass
+        return "1"
 
     def log_message(self, fmt, *args):  # quiet by default
         pass

@@ -177,6 +177,22 @@ def main():
                         "queue-depth dispatch, the A/B control arm "
                         "bench extra.serving.scaleout measures "
                         "against)")
+    p.add_argument("--prefill_replicas", type=int, default=0,
+                   help="disaggregated serving (ISSUE 17): dedicate "
+                        "the FIRST N of --router_replicas to chunked "
+                        "prefill; long prompts dispatch there, "
+                        "finished KV pages ship to the least-"
+                        "backlogged decode replica via the jitted "
+                        "page export/import pair, short prompts go "
+                        "direct. Requires 0 < N < router_replicas; "
+                        "0 = symmetric fleet (the default)")
+    p.add_argument("--ttft_slo_s", type=float, default=None,
+                   help="SLO-aware admission: reject (HTTP 503 with "
+                        "a modeled-drain-time Retry-After) when every "
+                        "candidate replica's modeled backlog exceeds "
+                        "this many seconds of device time (needs "
+                        "--cost_registry + --chip_spec on the "
+                        "engines; without them the gate stays open)")
     args = p.parse_args()
 
     import jax
@@ -285,9 +301,28 @@ def main():
                     devices=jax.devices()[i * tp:(i + 1) * tp]))
                 for i in range(n_rep)
             ]
-            engine = ReplicaRouter(replicas,
-                                   affinity=args.affinity_routing)
+            n_pre = args.prefill_replicas
+            if n_pre:
+                if not 0 < n_pre < n_rep:
+                    raise SystemExit(
+                        f"--prefill_replicas {n_pre} must leave at "
+                        f"least one decode replica out of "
+                        f"--router_replicas {n_rep}")
+                engine = ReplicaRouter(
+                    prefill_replicas=replicas[:n_pre],
+                    decode_replicas=replicas[n_pre:],
+                    affinity=args.affinity_routing,
+                    ttft_slo_s=args.ttft_slo_s)
+            else:
+                engine = ReplicaRouter(replicas,
+                                       affinity=args.affinity_routing,
+                                       ttft_slo_s=args.ttft_slo_s)
         else:
+            if args.prefill_replicas:
+                raise SystemExit(
+                    "--prefill_replicas needs --router_replicas > 1 "
+                    "(a disaggregated fleet has at least one prefill "
+                    "and one decode replica)")
             engine = build_engine(
                 devices=jax.devices()[:tp] if tp > 1 else None)
     serve_target = engine  # what MegatronServer gets (router or engine)
@@ -295,9 +330,16 @@ def main():
     if engine is not None and hasattr(engine, "replicas"):
         # router: per-engine facts from replica 0 (homogeneous fleet)
         engine = engine.replicas[0].engine
-        fleet = (f"{len(serve_target.replicas)} replicas x tp{tp} "
+        split = (f"{args.prefill_replicas} prefill + "
+                 f"{len(serve_target.replicas) - args.prefill_replicas}"
+                 f" decode" if args.prefill_replicas
+                 else f"{len(serve_target.replicas)} replicas")
+        fleet = (f"{split} x tp{tp} "
                  f"(prefix-affinity routing "
-                 f"{'ON' if args.affinity_routing else 'OFF'}), ")
+                 f"{'ON' if args.affinity_routing else 'OFF'}"
+                 + (f", ttft_slo {args.ttft_slo_s}s"
+                    if args.ttft_slo_s is not None else "")
+                 + "), ")
     elif engine is not None and engine.serving_tp > 1:
         fleet = f"tp{engine.serving_tp} mesh, "
     print(f"serving {args.model} from {path} on "
