@@ -29,10 +29,7 @@ import jax.numpy as jnp
 
 from megatron_llm_tpu.models.remat import tag as _savepoint
 from megatron_llm_tpu.models.rope import apply_rope
-from megatron_llm_tpu.ops.quantization import (
-    qdot,
-    scatter_quantized_rows,
-)
+from megatron_llm_tpu.ops.quantization import qdot
 from megatron_llm_tpu.parallel.mesh import (
     CONTEXT_AXIS,
     get_context,
@@ -249,16 +246,16 @@ def attention_block(
       standalone single-layer use;
     - paged (the continuous-batching engine, inference/engine.py):
       {"k_pages": (P, page_size, g, d), "v_pages": ..., "page_table":
-      (slots, max_pages) int32, "lengths": (slots,) int32} — the batch
-      axis is SLOTS at ragged per-slot lengths; this step's token K/V is
-      scattered into each slot's current page and attention streams only
-      the pages a slot owns (ops/decode_attention.paged_decode_attention);
-    - chunked paged (the engine's mixed prefill+decode step): the paged
-      form plus {"chunk_lens": (slots,) int32} — slot i contributes a
-      ragged span of chunk_lens[i] tokens starting at cache position
-      lengths[i] (s is the padded chunk width; 1 == a decode row, 0 ==
-      idle), scattered + attended in one ragged pass
-      (ops/prefill_attention.ragged_paged_prefill).
+      (slots, max_pages) int32, "lengths": (slots,) int32, optionally
+      "chunk_lens": (slots,) int32} — the batch axis is SLOTS at ragged
+      per-slot lengths; slot i contributes a ragged span of
+      chunk_lens[i] tokens starting at cache position lengths[i] (s is
+      the padded chunk width; 1 == a decode row, 0 == idle), scattered
+      into the slot's pages + attended in one ragged pass by THE paged
+      kernel (ops/prefill_attention.ragged_paged_attention — ISSUE 18:
+      decode scans, mixed rounds, and spec-verify all land here).
+      Without "chunk_lens" the form is the engine's single-token decode
+      step (s == 1): every slot is a width-1 chunk at its length.
 
     On a tp serving mesh (DecodeEngine(serving_tp>1), ISSUE 14) BOTH
     paged forms run group-sharded with no changes here: the pools
@@ -287,20 +284,29 @@ def attention_block(
     q, k, v = split_qkv(mixed, cfg)
     q = shard_activation(q, "groups")
 
-    if kv_cache is not None and "k_pages" in kv_cache \
-            and "chunk_lens" in kv_cache:
-        # chunked ragged prefill (the mixed prefill+decode step of the
-        # continuous-batching engine, ISSUE 4): slot i contributes a
-        # contiguous span of chunk_lens[i] tokens (<= s, ragged; 0 =
-        # idle) starting at cache position lengths[i]. The span's K/V is
-        # scattered into the slot's pages and attention runs causally
-        # against everything the slot has cached INCLUDING the span
-        # itself, in one pass (ops/prefill_attention.py). A decode row
-        # is the chunk_lens == 1 special case, so prefill chunks and
-        # decode rows share this branch inside one jitted step.
+    if kv_cache is not None and "k_pages" in kv_cache:
+        # THE paged branch (ISSUE 18 — the engine's one attention path):
+        # slot i contributes a contiguous span of chunk_lens[i] tokens
+        # (<= s, ragged; 0 = idle) starting at cache position
+        # lengths[i]. The span's K/V is scattered into the slot's pages
+        # and attention runs causally against everything the slot has
+        # cached INCLUDING the span itself, in one pass
+        # (ops/prefill_attention.ragged_paged_attention). Phase is a
+        # shape: the engine's decode scan passes no "chunk_lens" — every
+        # slot is then a width-1 chunk at its length, the exact decode
+        # semantics (attend positions 0..lengths[i] inclusive of the
+        # just-written token; retired slots carry all-null page-table
+        # rows, so their writes land on the pool's dead null page 0).
         g, qpk, d = cfg.num_query_groups, cfg.q_per_kv, cfg.head_dim
         lengths = kv_cache["lengths"]
-        chunk_lens = kv_cache["chunk_lens"]
+        chunked = "chunk_lens" in kv_cache
+        if chunked:
+            chunk_lens = kv_cache["chunk_lens"]
+        else:
+            assert s == 1, \
+                "paged KV cache without chunk_lens serves single-token " \
+                "decode steps"
+            chunk_lens = jnp.ones_like(lengths)
         page_table = kv_cache["page_table"]
         if position_ids is None:
             position_ids = lengths[:, None] + jnp.arange(s)[None, :]
@@ -308,15 +314,15 @@ def attention_block(
             q = apply_rope(q, rope_table, position_ids)
             k = apply_rope(k, rope_table, position_ids)
         from megatron_llm_tpu.ops.prefill_attention import (
-            ragged_paged_prefill,
+            ragged_paged_attention,
         )
 
-        # one gate, inside the entry point (ragged_prefill_block):
+        # one gate, inside the entry point (ragged_paged_block):
         # use_pallas=True means "kernel if eligible, XLA twin
-        # otherwise"; min_cache matches the paged-decode gate so decode
-        # rows take the SAME kernel-vs-XLA path in mixed and scan steps
+        # otherwise"; ONE gate means a decode row takes the SAME
+        # kernel-vs-XLA path in scan and mixed steps by construction
         quantized = "k_scales" in kv_cache  # int8 pools (ISSUE 9)
-        res = ragged_paged_prefill(
+        res = ragged_paged_attention(
             q, k, v, kv_cache["k_pages"], kv_cache["v_pages"],
             page_table, lengths, chunk_lens,
             use_pallas=cfg.use_decode_attn,
@@ -325,93 +331,17 @@ def attention_block(
             k_scales=kv_cache.get("k_scales"),
             v_scales=kv_cache.get("v_scales"),
         )
+        # cache pytree layout is carry-stable: "chunk_lens" stays a key
+        # only in the chunked form (the decode scan's carry never grows)
         new_cache = {"page_table": page_table,
-                     "lengths": lengths + chunk_lens,
-                     "chunk_lens": chunk_lens}
+                     "lengths": lengths + chunk_lens}
+        if chunked:
+            new_cache["chunk_lens"] = chunk_lens
         if quantized:
             (ctx, new_cache["k_pages"], new_cache["v_pages"],
              new_cache["k_scales"], new_cache["v_scales"]) = res
         else:
             ctx, new_cache["k_pages"], new_cache["v_pages"] = res
-        ctx = shard_activation(ctx.reshape(b, s, g, qpk * d), "heads") \
-            .reshape(b, s, -1)
-        out = qdot(ctx, attn_params["wo"], compute_dtype)
-        if "bo" in attn_params:
-            out = out + attn_params["bo"].astype(compute_dtype)
-        return out, new_cache
-    if kv_cache is not None and "k_pages" in kv_cache:
-        # paged decode step (s == 1): slot i's token sits at position
-        # lengths[i]; its K/V lands in pool page
-        # page_table[i, lengths[i] // page_size]. Retired/empty slots
-        # carry an all-null page-table row (engine contract), so their
-        # writes fall into the pool's null page 0 and never touch a live
-        # slot's cache.
-        assert s == 1, "paged KV cache serves single-token decode steps"
-        g, qpk, d = cfg.num_query_groups, cfg.q_per_kv, cfg.head_dim
-        lengths = kv_cache["lengths"]
-        page_table = kv_cache["page_table"]
-        if position_ids is None:
-            position_ids = lengths[:, None]
-        if rope_table is not None:
-            q = apply_rope(q, rope_table, position_ids)
-            k = apply_rope(k, rope_table, position_ids)
-        ps = kv_cache["k_pages"].shape[1]
-        pages = jnp.take_along_axis(
-            page_table, (lengths // ps)[:, None], axis=1)[:, 0]
-        offs = lengths % ps
-        quantized = "k_scales" in kv_cache  # int8 pools (ISSUE 9)
-        ksp = vsp = None
-        if quantized:
-            # quantize-at-write through the ONE shared definition
-            # (ops/quantization.scatter_quantized_rows): the step's
-            # post-RoPE K/V rows become int8 + per-(slot, group) fp32
-            # scales at the same [page, offset] of both pools (retired
-            # slots scribble the null page with both, like the data)
-            kp, ksp = scatter_quantized_rows(
-                kv_cache["k_pages"], kv_cache["k_scales"], pages, offs,
-                k[:, 0])
-            vp, vsp = scatter_quantized_rows(
-                kv_cache["v_pages"], kv_cache["v_scales"], pages, offs,
-                v[:, 0])
-        else:
-            kp = kv_cache["k_pages"].at[pages, offs].set(k[:, 0])
-            vp = kv_cache["v_pages"].at[pages, offs].set(v[:, 0])
-        new_cache = {"k_pages": kp, "v_pages": vp,
-                     "page_table": page_table, "lengths": lengths + 1}
-        if quantized:
-            new_cache["k_scales"] = ksp
-            new_cache["v_scales"] = vsp
-        from megatron_llm_tpu.ops.decode_attention import (
-            _xla_paged_decode,
-            _xla_paged_decode_quant,
-            paged_decode_attention,
-            paged_decode_attn_block,
-        )
-
-        bt = None
-        if cfg.use_decode_attn:
-            bt = paged_decode_attn_block(
-                s, qpk, d, ps, page_table.shape[1],
-                min_cache=cfg.decode_attn_min_cache,
-                kv_dtype=kp.dtype,
-                interpret=cfg.decode_attn_interpret,
-            )
-        if bt is not None:
-            ctx = paged_decode_attention(
-                q, kp, vp, page_table, lengths + 1, use_pallas=True,
-                interpret=cfg.decode_attn_interpret,
-                k_scales=ksp, v_scales=vsp,
-            )
-        elif quantized:
-            # the quantize-then-dequantize twin of the int8 kernel —
-            # the CPU oracle AND the off-TPU serving path
-            ctx = _xla_paged_decode_quant(q, kp, vp, ksp, vsp,
-                                          page_table, lengths + 1)
-        else:
-            # the paged kernel's shapes-and-math twin (gather pages to
-            # the dense view + the _xla_decode op sequence) — ONE shared
-            # definition, same contract as the dense branches below
-            ctx = _xla_paged_decode(q, kp, vp, page_table, lengths + 1)
         ctx = shard_activation(ctx.reshape(b, s, g, qpk * d), "heads") \
             .reshape(b, s, -1)
         out = qdot(ctx, attn_params["wo"], compute_dtype)

@@ -317,7 +317,9 @@ class GPTModel:
         scale pools `k/v_scales_layers` of (num_pages, page_size, g) —
         one symmetric scale per (token, group), written by the same
         scatter paths that write the data and consumed in-register by
-        the paged kernels — roughly halving the pool's bytes/token
+        the ragged paged attention kernel (ops/prefill_attention.py,
+        the one paged entry point) — roughly halving the pool's
+        bytes/token
         (docs/GUIDE.md, "Quantized serving").
 
         `mesh_ctx` (ISSUE 14, the tp-sharded engine): a

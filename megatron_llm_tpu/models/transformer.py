@@ -304,12 +304,14 @@ def transformer_stack(
             "k_layers" in kv_caches or "k_pages_layers" in kv_caches
         ), "unrolled (tuple) layer params are the decode fast path"
         if "k_pages_layers" in kv_caches:
-            # paged decode (continuous-batching engine): per-layer page
+            # paged serving (continuous-batching engine): per-layer page
             # POOLS with one shared page table + per-slot lengths; each
-            # layer scatters its token column into the slot's current
-            # page and reads back only owned pages (attention_block's
-            # paged branch). Same unrolled structure as the dense decode
-            # fast path — standalone per-layer buffers, no stack slicing.
+            # layer scatters its span into the slot's pages and reads
+            # back only owned pages through THE ragged paged attention
+            # kernel (attention_block's one paged branch, ISSUE 18 —
+            # decode rows are width-1 chunks of the same kernel). Same
+            # unrolled structure as the dense decode fast path —
+            # standalone per-layer buffers, no stack slicing.
             pt = kv_caches["page_table"]
             lens = kv_caches["lengths"]
             # chunked mixed prefill+decode step (ISSUE 4): per-slot

@@ -1,8 +1,10 @@
 """Pallas TPU kernels: flash attention (training), decode attention
-(KV-cached serving), ragged paged prefill (chunked prompt admission),
-fused RMSNorm. Each module dispatches to a numerically matching XLA
-path off-TPU; `interpret=True` runs the real kernels through the Pallas
-interpreter (the CPU test suites)."""
+(dense KV-cached serving), THE ragged paged attention kernel (every
+phase of the continuous-batching engine — decode rows, ragged prompt
+chunks, fp and int8 pools; ISSUE 18 collapsed the paged fork to this
+one entry point), fused RMSNorm. Each module dispatches to a
+numerically matching XLA path off-TPU; `interpret=True` runs the real
+kernels through the Pallas interpreter (the CPU test suites)."""
 
 from megatron_llm_tpu.ops.decode_attention import (  # noqa: F401
     decode_attention,
@@ -13,6 +15,6 @@ from megatron_llm_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention_with_lse,
 )
 from megatron_llm_tpu.ops.prefill_attention import (  # noqa: F401
-    ragged_paged_prefill,
-    ragged_prefill_block,
+    ragged_paged_attention,
+    ragged_paged_block,
 )

@@ -11,8 +11,10 @@ blocks in training, with decode-specific structure:
 - grid (batch, group, cache_block): one grid step reads each K/V block
   ONCE per GQA group and serves all `q_per_kv` query heads of the group
   from it (the (position, head) fold of the flash kernel, with s == 1);
-- online softmax in the exp2 domain (same running (m, l, acc) scheme and
-  constants as the flash forward), accumulated in fp32 VMEM scratch;
+- the mask / online-softmax / fp32-accumulator core is the shared
+  template of ops/flash_attention.py (`_causal_invalid` +
+  `_softmax_init/accum/finalize`, ISSUE 18) instantiated at the dense
+  standalone-cache parameterization;
 - the VALID cache length rides a scalar-prefetch operand: block index
   maps clamp past-the-end blocks to the last valid block (Mosaic elides
   the repeated DMA, so masked grid steps cost no HBM traffic — the cache
@@ -32,35 +34,13 @@ to `_xla_decode`, a numerically matching reference, elsewhere.
 `decode_attn_block` is the static viability check the model layer gates
 on; it returns the chosen cache block size or None (XLA fallback).
 
-PAGED VARIANT (ISSUE 3 tentpole, after Ragged Paged Attention — arxiv
-2604.15464): `paged_decode_attention` serves the continuous-batching
-engine (inference/engine.py). The cache is a GLOBAL page pool
-(num_pages, page_size, g, d) shared by every slot; each slot owns a row
-of a (slots, max_pages) page table plus a per-slot valid length. The
-kernel is the same exp2 online softmax with two changes: the valid
-length is read per grid row (`lengths[slot]`, not one shared scalar),
-and the K/V block index map dereferences the scalar-prefetched page
-table — grid step (slot, group, j) DMAs pool page
-`page_table[slot, j]`, with past-the-length steps clamped to the slot's
-last valid page so Mosaic elides the repeated DMA. Cache traffic
-follows each slot's CURRENT length; slots at different lengths coexist
-in one launch with zero padding traffic between them. Page 0 of the
-pool is the NULL page by convention: unowned page-table entries point
-at it and retired/inactive slots park there, so clamped DMAs always
-have a real page to read. `_xla_paged_decode` (gather pages to the
-dense "tgd" view, then the `_xla_decode` math) is the numerically
-matching fallback and the CPU test oracle.
-
-INT8 KV PAGES (ISSUE 9 tentpole): the paged variant also serves int8
-pools — K/V stored int8 with per-(token, group) fp32 scales in parallel
-(num_pages, page_size, g) scale pools (ops/quantization.py is the ONE
-rounding/scale convention). The kernel DMAs the scale column with its
-page through the same clamped index map and dequantizes in-register
-before the unchanged fp32 online-softmax math; `_xla_paged_decode_quant`
-(dequantize pools -> the fp twin) is the quantize-then-dequantize
-oracle and the off-TPU serving path. Halves the decode kernel's HBM
-cache traffic; quantization itself happens at write time in the
-engine's scatter paths, never here.
+This module serves DENSE per-sequence caches only. The continuous-
+batching engine's paged pool — every phase of it, decode rows included,
+fp and int8 — is served by THE ragged paged attention kernel in
+ops/prefill_attention.py (ISSUE 18 collapsed the former paged decode /
+ragged prefill / int8-twin fork into that one kernel; a decode step is
+its width-1 chunk). `_xla_decode` here is a layout shim over the shared
+`_xla_attend` dense core of that module.
 """
 
 from __future__ import annotations
@@ -75,10 +55,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 from megatron_llm_tpu.ops.flash_attention import (
     LOG2E,
-    NEG_INF,
+    _causal_invalid,
     _compiler_params,
     _out_struct,
+    _softmax_accum,
+    _softmax_finalize,
+    _softmax_init,
+    NEG_INF,
 )
+from megatron_llm_tpu.ops.prefill_attention import _xla_attend
 
 # swept space: 256 balances DMA amortization against the clamp granularity
 # (past-the-end traffic is at most one block); _choose_block_t shrinks to
@@ -127,31 +112,22 @@ def decode_attn_block(s: int, qpk: int, d: int, T: int, *,
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_t, rows,
-                   qpk, d, num_t_blocks, sm_scale, s, split_boundary=True,
-                   batched_len=False, quantized=False):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, block_t, rows, qpk, d, num_t_blocks,
+                   sm_scale, s, split_boundary=True):
     """Grid (b, g, num_t_blocks); the t dim carries the online-softmax
     state in VMEM scratch. Row r of the folded (rows, d) q block is query
-    position offset + r // qpk (head fastest), offset = length - s.
-    `batched_len` reads a PER-ROW length (the paged engine's ragged
-    slots) instead of the dense path's one shared scalar. `quantized`
-    (the int8-KV paged variant, ISSUE 9): k/v blocks arrive int8 with
-    per-(token, group) fp32 scale columns as two extra (block_t, 1)
-    operands, dequantized in-register before the same fp32 QK/PV math —
-    the softmax/accumulation scheme is byte-identical to the fp path."""
-    if quantized:
-        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
-    else:
-        o_ref, m_scr, l_scr, acc_scr = rest
+    position offset + r // qpk (head fastest), offset = length - s. The
+    shared flash template at the dense decode parameterization: causal
+    predicate `col <= offset + row`, no pad rows (every row is a live
+    query token)."""
     j = pl.program_id(2)
-    length = len_ref[pl.program_id(0)] if batched_len else len_ref[0]
+    length = len_ref[0]
     offset = length - s
 
     @pl.when(j == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        _softmax_init(m_scr, l_scr, acc_scr)
 
     def _accum(masked):
         # fp32 QK on tiny row counts: decode is cache-bandwidth-bound, so
@@ -159,11 +135,6 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_t, rows,
         # (sm_scale folded with log2(e), flash kernel convention)
         qb = q_ref[:].reshape(rows, d)
         kb = k_ref[:].reshape(block_t, d).astype(jnp.float32)
-        if quantized:
-            # dequantize in-register: one fp32 multiply per cache
-            # element against the page's (block_t, 1) scale column —
-            # HBM saw only the int8 bytes
-            kb = kb * ks_ref[:].reshape(block_t, 1)
         sc = jax.lax.dot_general(
             qb.astype(jnp.float32), kb,
             (((1,), (1,)), ((), ())),
@@ -172,30 +143,12 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_t, rows,
         if masked:
             # causal-within-step + cache-length mask in one predicate:
             # col c valid for row r iff c <= offset + r//qpk
-            row_pos = offset + (
-                jax.lax.broadcasted_iota(jnp.int32, (rows, block_t), 0)
-                // qpk
+            sc = jnp.where(
+                _causal_invalid(rows, block_t, qpk, offset, j * block_t),
+                NEG_INF, sc,
             )
-            col = j * block_t + jax.lax.broadcasted_iota(
-                jnp.int32, (rows, block_t), 1
-            )
-            sc = jnp.where(col > row_pos, NEG_INF, sc)
-        m_prev = m_scr[:]  # (rows, 1)
-        m_cur = jnp.max(sc, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp2(m_prev - m_new)
-        p = jnp.exp2(sc - m_new)  # (rows, block_t)
-        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
-        if quantized:
-            vb = v_ref[:].reshape(block_t, d).astype(jnp.float32) \
-                * vs_ref[:].reshape(block_t, 1)
-        else:
-            vb = v_ref[:].reshape(block_t, d)
-            p = p.astype(v_ref.dtype)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p, vb, preferred_element_type=jnp.float32,
-        )
-        m_scr[:] = m_new
+        _softmax_accum(sc, v_ref[:].reshape(block_t, d), m_scr, l_scr,
+                       acc_scr, p_dtype=v_ref.dtype)
 
     # blocks entirely past the valid length skip compute (their DMA was
     # clamped to the last valid block by the index map); interior blocks
@@ -221,8 +174,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_t, rows,
 
     @pl.when(j == num_t_blocks - 1)
     def _finalize():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[:] = (acc_scr[:] / l).astype(o_ref.dtype).reshape(o_ref.shape)
+        out, _ = _softmax_finalize(l_scr, acc_scr)
+        o_ref[:] = out.astype(o_ref.dtype).reshape(o_ref.shape)
 
 
 def _decode_pallas(q, k, v, length, layout, block_t, interpret):
@@ -298,7 +251,8 @@ def _decode_pallas(q, k, v, length, layout, block_t, interpret):
 
 
 # ---------------------------------------------------------------------------
-# XLA reference (the pre-kernel decode math, both layouts)
+# XLA reference (the pre-kernel decode math, both layouts): a layout shim
+# over the shared `_xla_attend` dense core (ops/prefill_attention.py)
 # ---------------------------------------------------------------------------
 
 
@@ -310,21 +264,8 @@ def _xla_decode(q, k, v, length, layout):
     if layout == "tgd":
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
-    T = k.shape[2]
-    offset = length - s
-    qb = q.transpose(0, 2, 1, 3, 4).reshape(b, g, s * qpk, d)
-    scores = jax.lax.dot_general(
-        qb, k, (((3,), (3,)), ((0, 1), (0, 1))),
-        preferred_element_type=jnp.float32,
-    ) * (1.0 / jnp.sqrt(d).astype(jnp.float32))  # (b, g, s*qpk, T)
-    row_pos = offset + jnp.arange(s * qpk) // qpk
-    mask = jnp.arange(T)[None, :] > row_pos[:, None]
-    scores = jnp.where(mask[None, None], jnp.finfo(jnp.float32).min, scores)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jax.lax.dot_general(
-        probs, v, (((3,), (2,)), ((0, 1), (0, 1))),
-    )  # (b, g, s*qpk, d)
-    return out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4)
+    row_pos = (length - s) + jnp.arange(s * qpk) // qpk
+    return _xla_attend(q, k, v, row_pos)
 
 
 def decode_attention(
@@ -351,214 +292,3 @@ def decode_attention(
         if bt is not None:
             return _decode_pallas(q, k, v, length, layout, bt, interpret)
     return _xla_decode(q, k, v, length, layout)
-
-
-# ---------------------------------------------------------------------------
-# Paged variant: global page pool + per-slot page table (the
-# continuous-batching serving cache, inference/engine.py)
-# ---------------------------------------------------------------------------
-
-
-def paged_decode_attn_block(s: int, qpk: int, d: int, page_size: int,
-                            num_slot_pages: int, *,
-                            min_cache: int = 0,
-                            kv_dtype=None,
-                            interpret: bool = False) -> Optional[int]:
-    """Static dispatch check for the paged kernel: returns the block size
-    (== page_size; the page IS the DMA unit) or None for the XLA path.
-
-    Same territory as `decode_attn_block` — single-token steps,
-    lane-aligned head dim, a big-enough cache — with the block constraint
-    moved onto the page: `page_size` must tile sublanes (multiple of 16
-    covers bf16; int8 pools need 32, the int8 sublane tile), and the
-    per-slot reach num_slot_pages * page_size stands in for the
-    allocated T of the dense gate.
-    """
-    if not (interpret or jax.default_backend() == "tpu"):
-        return None
-    if s != 1 or s * qpk > MAX_DECODE_ROWS or d % 128 != 0:
-        return None
-    is_int8 = kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8
-    sublane = 32 if is_int8 else 16
-    if page_size < sublane or page_size % sublane != 0:
-        return None
-    if num_slot_pages * page_size < max(min_cache, 16):
-        return None
-    return page_size
-
-
-def _paged_pallas(q, k_pages, v_pages, page_table, lengths, interpret,
-                  k_scales=None, v_scales=None):
-    """q: (slots, 1, g, qpk, d); k/v_pages: (num_pages, page_size, g, d);
-    page_table: (slots, max_pages) int32 pool indices; lengths: (slots,)
-    int32 valid positions per slot (0 = empty slot -> zero output).
-    k/v_scales (int8 pools only): (num_pages, page_size, g) fp32
-    per-(token, group) scales, DMA'd page-by-page alongside the data
-    through the same clamped index map. Returns (slots, 1, g, qpk, d)
-    in q's dtype."""
-    b, s, g, qpk, d = q.shape
-    assert s == 1, "paged decode is single-token by construction"
-    page_size = k_pages.shape[1]
-    max_pages = page_table.shape[1]
-    rows = qpk
-    quantized = k_scales is not None
-
-    qf = q.transpose(0, 2, 1, 3, 4).reshape(b, g, rows, d)
-    # same Mosaic small-memref workaround as the dense launcher: rows
-    # below one fp32 sublane tile launch q/o in fp32
-    out_dtype = q.dtype if rows % 8 == 0 else jnp.float32
-    qf = qf.astype(out_dtype)
-
-    body = functools.partial(
-        _decode_kernel, block_t=page_size, rows=rows, qpk=qpk, d=d,
-        num_t_blocks=max_pages, sm_scale=1.0 / (d ** 0.5), s=1,
-        split_boundary=not interpret, batched_len=True,
-        quantized=quantized,
-    )
-
-    def kernel(len_ref, pt_ref, *rest):
-        # the page table is consumed entirely by the index maps; the
-        # online-softmax body is the dense kernel's, fed per-slot lengths
-        body(len_ref, *rest)
-
-    def page_index(ib, j, len_ref, pt_ref):
-        # past-the-length grid steps re-read the slot's LAST valid page
-        # (repeated index -> elided DMA); empty slots (length 0) clamp to
-        # table entry 0, which points at the pool's null page.
-        last = jnp.maximum(len_ref[ib] - 1, 0) // page_size
-        return pt_ref[ib, jnp.minimum(j, last)]
-
-    q_spec = pl.BlockSpec(
-        (None, None, rows, d),
-        lambda ib, ig, j, len_ref, pt_ref: (ib, ig, 0, 0),
-    )
-    kv_spec = pl.BlockSpec(
-        (None, page_size, None, d),
-        lambda ib, ig, j, len_ref, pt_ref: (
-            page_index(ib, j, len_ref, pt_ref), 0, ig, 0
-        ),
-    )
-    in_specs = [q_spec, kv_spec, kv_spec]
-    operands = [qf, k_pages, v_pages]
-    if quantized:
-        # the (page_size, 1) scale column of this (page, group): rides
-        # the SAME clamped page index map as the data it scales
-        scale_spec = pl.BlockSpec(
-            (None, page_size, 1),
-            lambda ib, ig, j, len_ref, pt_ref: (
-                page_index(ib, j, len_ref, pt_ref), 0, ig
-            ),
-        )
-        in_specs += [scale_spec, scale_spec]
-        operands += [k_scales, v_scales]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, g, max_pages),
-        in_specs=in_specs,
-        out_specs=q_spec,
-        scratch_shapes=[
-            pltpu.VMEM((rows, 1), jnp.float32),
-            pltpu.VMEM((rows, 1), jnp.float32),
-            pltpu.VMEM((rows, d), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=_out_struct((b, g, rows, d), out_dtype, qf, k_pages,
-                              v_pages),
-        compiler_params=None if interpret else _compiler_params(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(jnp.asarray(lengths, jnp.int32), jnp.asarray(page_table, jnp.int32),
-      *operands)
-    return out.reshape(b, g, 1, qpk, d).transpose(0, 2, 1, 3, 4) \
-        .astype(q.dtype)
-
-
-def _xla_paged_decode(q, k_pages, v_pages, page_table, lengths):
-    """Gather the owned pages into the dense (b, g, T, d) view, then the
-    exact `_xla_decode` op sequence with per-row lengths — the
-    shapes-and-math twin of the paged kernel, used off-TPU and by the
-    engine's exact-match tests. Zero-probability columns (masked past
-    each slot's length) multiply whatever the unwritten pool pages hold
-    by an exact fp 0, so the gathered width never leaks into values."""
-    b, s, g, qpk, d = q.shape
-    page_size = k_pages.shape[1]
-    max_pages = page_table.shape[1]
-    T = max_pages * page_size
-    k = k_pages[page_table].reshape(b, T, g, d).transpose(0, 2, 1, 3)
-    v = v_pages[page_table].reshape(b, T, g, d).transpose(0, 2, 1, 3)
-    qb = q.transpose(0, 2, 1, 3, 4).reshape(b, g, s * qpk, d)
-    scores = jax.lax.dot_general(
-        qb, k, (((3,), (3,)), ((0, 1), (0, 1))),
-        preferred_element_type=jnp.float32,
-    ) * (1.0 / jnp.sqrt(d).astype(jnp.float32))  # (b, g, s*qpk, T)
-    row_pos = (lengths - s)[:, None] + jnp.arange(s * qpk)[None, :] // qpk
-    mask = jnp.arange(T)[None, None, :] > row_pos[:, :, None]
-    scores = jnp.where(mask[:, None], jnp.finfo(jnp.float32).min, scores)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jax.lax.dot_general(
-        probs, v, (((3,), (2,)), ((0, 1), (0, 1))),
-    )  # (b, g, s*qpk, d)
-    # empty slots (length 0, every column masked): the softmax above
-    # degenerates to uniform-over-garbage; pin them to the kernel's
-    # exact-zero output so both paths share one contract
-    out = jnp.where((lengths > 0)[:, None, None, None], out,
-                    jnp.zeros((), out.dtype))
-    return out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4)
-
-
-def _xla_paged_decode_quant(q, k_pages, v_pages, k_scales, v_scales,
-                            page_table, lengths):
-    """Quantize-then-dequantize oracle for the int8 paged kernel:
-    dequantize the int8 pools against their per-(token, group) scale
-    pools to the fp32 view, then the exact `_xla_paged_decode` op
-    sequence — what the in-register dequantization inside the kernel
-    must reproduce (same fp32 values entering the same math). Off-TPU
-    this IS the serving path (the engine's CPU fallback), so the oracle
-    and the fallback can never drift."""
-    kf = k_pages.astype(jnp.float32) * k_scales[..., None]
-    vf = v_pages.astype(jnp.float32) * v_scales[..., None]
-    return _xla_paged_decode(q, kf, vf, page_table, lengths)
-
-
-def paged_decode_attention(
-    q: jnp.ndarray,  # (slots, 1, g, qpk, d)
-    k_pages: jnp.ndarray,  # (num_pages, page_size, g, d); int8 OK
-    v_pages: jnp.ndarray,
-    page_table: jnp.ndarray,  # (slots, max_pages) int32 pool indices
-    lengths: jnp.ndarray,  # (slots,) int32 valid positions incl. this step
-    use_pallas: Optional[bool] = None,
-    interpret: bool = False,
-    k_scales: Optional[jnp.ndarray] = None,  # (num_pages, page_size, g)
-    v_scales: Optional[jnp.ndarray] = None,  # fp32; required for int8
-) -> jnp.ndarray:
-    """Ragged paged decode attention: slot i attends its query token to
-    cache positions 0..lengths[i]-1, streamed page-by-page from the pool
-    through its page-table row. Positions past lengths[i] are masked
-    in-kernel; a slot with lengths[i] == 0 returns zeros. Int8 pools
-    (ISSUE 9) carry per-(token, group) fp32 scale pools and dequantize
-    in-register (kernel) or on the gathered view (XLA twin)."""
-    quantized = k_pages.dtype == jnp.int8
-    if quantized:
-        assert k_scales is not None and v_scales is not None, \
-            "int8 KV pools require k_scales/v_scales"
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
-    if use_pallas:
-        b, s, g, qpk, d = q.shape
-        bt = paged_decode_attn_block(
-            s, qpk, d, k_pages.shape[1], page_table.shape[1],
-            kv_dtype=k_pages.dtype,
-            interpret=interpret,
-        )
-        if bt is not None:
-            return _paged_pallas(q, k_pages, v_pages, page_table, lengths,
-                                 interpret, k_scales=k_scales,
-                                 v_scales=v_scales)
-    if quantized:
-        return _xla_paged_decode_quant(q, k_pages, v_pages, k_scales,
-                                       v_scales, page_table, lengths)
-    return _xla_paged_decode(q, k_pages, v_pages, page_table, lengths)

@@ -141,6 +141,75 @@ def _choose_block(size: int, requested: int, qpk: int = 1):
     return b if b >= 8 and size % b == 0 else None
 
 
+# ---------------------------------------------------------------------------
+# Shared attention-kernel template (ISSUE 18): the mask / online-softmax /
+# fp32-accumulator core that every attention kernel in ops/ instantiates.
+# The flash forward (dense training), the dense decode kernel, and the
+# unified ragged paged kernel (ops/prefill_attention.py) all run their
+# reduction through these helpers, so the exp2-domain running-(m, l, acc)
+# scheme and the mask predicate are each ONE definition. The mask is a
+# pluggable SHAPE: `_causal_invalid` is the causal family — dense causal
+# (pos_base = q-block start), decode row (pos_base = cache offset), and
+# ragged chunk (pos_base = slot start + block start, plus the pad-row
+# bound `valid_rows`) are all parameterizations of one predicate; a
+# sliding-window or packed-doc mask slots in as a new predicate function
+# without touching any kernel body.
+# ---------------------------------------------------------------------------
+
+
+def _causal_invalid(rows, block_k, qpk, pos_base, col_base,
+                    valid_rows=None):
+    """(rows, block_k) bool block, True = masked out. Folded row r (head
+    fastest) is token r // qpk at causal position pos_base + r // qpk;
+    column c is cache position col_base + c. With `valid_rows` (the
+    ragged-chunk pad bound), rows at tokens >= valid_rows mask EVERY
+    column. pos_base / valid_rows may be traced scalars."""
+    tok = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0) // qpk
+    col = col_base + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, block_k), 1
+    )
+    invalid = col > pos_base + tok
+    if valid_rows is not None:
+        invalid = invalid | (tok >= valid_rows)
+    return invalid
+
+
+def _softmax_init(m_scr, l_scr, acc_scr):
+    """Reset the running (max, sum, acc) VMEM scratch at the first
+    reduction step of a grid row."""
+    m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+
+
+def _softmax_accum(sc, vb, m_scr, l_scr, acc_scr, p_dtype=None):
+    """One exp2-domain online-softmax step: fold the (rows, block_k)
+    score block `sc` and its value block `vb` into the running fp32
+    (m, l, acc) scratch. `p_dtype` casts the probabilities before the PV
+    matmul (the fp kernels feed the MXU in the value dtype); the
+    int8-dequant epilogue passes None and keeps fp32 — its vb was
+    already dequantized in-register."""
+    m_prev = m_scr[:]  # (rows, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+    alpha = jnp.exp2(m_prev - m_new)
+    p = jnp.exp2(sc - m_new)  # (rows, block_k)
+    l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+    if p_dtype is not None:
+        p = p.astype(p_dtype)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+        p, vb, preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = m_new
+
+
+def _softmax_finalize(l_scr, acc_scr):
+    """Close the reduction: returns (acc / max(l, eps), l) in fp32. The
+    eps floor keeps all-masked rows finite; callers re-mask such rows to
+    their exact-zero contract where one exists."""
+    l = jnp.maximum(l_scr[:], 1e-30)
+    return acc_scr[:] / l, l
+
+
 def _masked_scores(q_ref, k_ref, i, j, *, masked, block_q, block_k, qpk, d,
                    sm_scale):
     """Recompute the scaled score block in the exp2 domain — the ONE
@@ -159,13 +228,10 @@ def _masked_scores(q_ref, k_ref, i, j, *, masked, block_q, block_k, qpk, d,
         preferred_element_type=jnp.float32,
     ) * (sm_scale * LOG2E)
     if masked:
-        q_pos = i * block_q + (
-            jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0) // qpk
+        sc = jnp.where(
+            _causal_invalid(rows, block_k, qpk, i * block_q, j * block_k),
+            NEG_INF, sc,
         )
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (rows, block_k), 1
-        )
-        sc = jnp.where(k_pos > q_pos, NEG_INF, sc)
     return sc
 
 
@@ -184,9 +250,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(j == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        _softmax_init(m_scr, l_scr, acc_scr)
 
     def _accum(masked):
         # rows: (pos, head), head fastest; running stats in exp2 domain
@@ -194,18 +258,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             q_ref, k_ref, i, j, masked=masked, block_q=block_q,
             block_k=block_k, qpk=qpk, d=d, sm_scale=sm_scale,
         )
-        m_prev = m_scr[:]  # (rows, 1)
-        m_cur = jnp.max(sc, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp2(m_prev - m_new)
-        p = jnp.exp2(sc - m_new)  # (rows, block_k)
-        l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p.astype(v_ref.dtype), v_ref[:].reshape(block_k, d),
-            preferred_element_type=jnp.float32,
-        )
-        m_scr[:] = m_new
-        l_scr[:] = l_new
+        _softmax_accum(sc, v_ref[:].reshape(block_k, d), m_scr, l_scr,
+                       acc_scr, p_dtype=v_ref.dtype)
 
     if causal:
         # skip fully-masked K blocks (k block start > last q position);
@@ -235,8 +289,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(j == num_k_blocks - 1)
     def _finalize():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[:] = (acc_scr[:] / l).astype(o_ref.dtype).reshape(
+        out, l = _softmax_finalize(l_scr, acc_scr)
+        o_ref[:] = out.astype(o_ref.dtype).reshape(
             1, block_q, qpk * d
         )
         # rows-major (rows, 1) layout: Mosaic can't shape-cast the lane dim

@@ -1,45 +1,61 @@
-"""Pallas ragged paged prefill kernel — chunked prompt admission against
-the page pool (ISSUE 4 tentpole, after Ragged Paged Attention — arxiv
-2604.15464).
+"""THE ragged paged attention kernel (ISSUE 18 tentpole, after Ragged
+Paged Attention — arxiv 2604.15464): one Pallas kernel serves every
+inference phase of the continuous-batching engine.
 
-The continuous-batching engine (inference/engine.py) used to admit a
-request by running its WHOLE prompt through a bucketed dense prefill
-between decode rounds: a long prompt stalled every in-flight decode slot
-for the full prefill, pow2 bucketing padded short prompts, and each
-bucket minted its own executable. This module is the kernel side of the
-fix: one launch serves a batch of ragged QUERY CHUNKS — each chunk a
-contiguous span of one slot's prompt, at an arbitrary start offset —
-reading K/V through the same scalar-prefetched per-slot page table the
-paged decode kernel uses. A single-token decode row is just a chunk of
-length 1 at offset length-1, so mixed prefill+decode steps run through
-ONE code path (models/attention.py chunked paged branch).
+The paged kernel family used to be a six-way fork — paged decode, ragged
+prefill, and int8-quantized twins of both, next to flash (train) and
+dense decode — the same exp2-online-softmax inner loop written ~6 ways,
+each needing its own parity suite and its own GSPMD check under the tp
+serving mesh. This module collapses the paged side to ONE kernel:
 
-Kernel structure (the decode/flash family conventions):
+- **phase is a shape, not a variant**: a launch serves a batch of
+  ragged QUERY CHUNKS — each a contiguous span of one slot's prompt at
+  an arbitrary start offset — and a single-token decode row IS the
+  width-1 chunk at offset `length` (chunk_lens == 1). The engine's
+  decode scan, mixed prefill+decode rounds, and spec-verify steps all
+  dispatch here (models/attention.py, ONE paged branch); the retired
+  standalone paged decode entry is this kernel at C == 1, pinned
+  bitwise by the suites before the fork was deleted.
+- **kv dtype is a kernel parameter, not a variant**: fp pools run the
+  plain epilogue; int8 pools (per-(token, group) fp32 scale columns in
+  parallel scale pools, ISSUE 9) select the in-register dequant
+  epilogue — the scale column rides the SAME clamped page index map as
+  its data, and the fp32 online-softmax math is unchanged.
+- **the mask/accumulator core is the shared template** of
+  ops/flash_attention.py (`_causal_invalid` + `_softmax_init/accum/
+  finalize`): flash instantiates it for dense training, the dense
+  decode kernel for standalone caches, and this kernel for the paged
+  pool — mask shapes are pluggable predicates, so sliding-window and
+  packed-doc masks later cost one predicate, not six kernels.
 
-- grid (chunk, group, q_block, page): each grid step reads one pool page
-  ONCE per GQA group and serves all `q_per_kv` query heads of the group
-  from it; the page dim carries the online-softmax state in VMEM
+Kernel structure:
+
+- grid (chunk, group, q_block, page): each grid step reads one pool
+  page ONCE per GQA group and serves all `q_per_kv` query heads of the
+  group from it; the page dim carries the online-softmax state in VMEM
   scratch (exp2 domain, fp32 accumulation — the flash forward scheme);
 - the per-chunk START OFFSET and VALID LENGTH ride scalar-prefetch
   operands: causal-within-chunk masking is `col <= start + row`, rows
-  past the chunk's valid length are pad (exact-zero output, the empty-
-  slot contract of the paged decode kernel), and the K/V index map
-  dereferences the page table with past-the-need pages clamped to the
-  last needed page — Mosaic elides the repeated DMA, so cache traffic
-  follows `start + len`, not the allocated table width;
+  past the chunk's valid length are pad (exact-zero output), and the
+  K/V index map dereferences the page table with past-the-need pages
+  clamped to the last needed page — Mosaic elides the repeated DMA, so
+  cache traffic follows `start + len`, not the allocated table width;
 - interior/boundary split: page blocks fully below the causal diagonal
   and fully inside the valid length run maskless; only straddling
   blocks pay the iota/select VPU work (split_boundary=False under the
   interpreter, the same vma workaround as the flash/decode kernels).
 
-`ragged_paged_prefill` is the public entry: it first SCATTERS the
-chunk's own K/V into its slot's pages (valid rows only; pad rows land
-on the pool's dead null page 0), then attends — one jitted pass, so the
-chunk's in-span causal columns are read back from the pool it just
-wrote. `_xla_ragged_prefill` (gather pages to the dense view, mask,
-softmax — the `_xla_paged_decode` op sequence generalized to ragged
-rows) is the numerically matching fallback and the CPU test oracle;
-`interpret=True` runs the real kernel through the Pallas interpreter.
+`ragged_paged_attention` is the ONE public paged entry point (a tier-1
+guard in tests/test_static_analysis.py holds it at one): it first
+SCATTERS the chunk's own K/V into its slot's pages (valid rows only;
+pad rows land on the pool's dead null page 0; int8 pools quantize at
+write through ops/quantization.scatter_quantized_rows), then attends —
+one jitted pass, so the chunk's in-span causal columns are read back
+from the pool it just wrote. `_xla_paged_reference` (gather pages to
+the dense view, then the `_xla_attend` dense core — also parameterized
+by kv dtype) is the numerically matching fallback, the off-TPU serving
+path, and the one test oracle; `interpret=True` runs the real kernel
+through the Pallas interpreter.
 """
 
 from __future__ import annotations
@@ -55,49 +71,51 @@ from jax.experimental.pallas import tpu as pltpu
 from megatron_llm_tpu.ops.flash_attention import (
     LOG2E,
     NEG_INF,
+    _causal_invalid,
     _compiler_params,
     _out_struct,
+    _softmax_accum,
+    _softmax_finalize,
+    _softmax_init,
 )
 
 # folded (token, head) rows per grid program — the flash kernels' VMEM
 # bound for the fp32 score block and accumulator
-MAX_PREFILL_ROWS = 2048
+MAX_PAGED_ROWS = 2048
 
 
 def _choose_block_q(C: int, qpk: int) -> Optional[int]:
     """Largest power-of-2 q block (in TOKENS) dividing the padded chunk
-    width C with folded rows (block * qpk) under MAX_PREFILL_ROWS.
+    width C with folded rows (block * qpk) under MAX_PAGED_ROWS.
     Chunks of any width >= 1 are served (the engine's width buckets are
-    pow2); None only when no divisor fits."""
+    pow2; C == 1 is the decode row); None only when no divisor fits."""
     b = 1 << (C.bit_length() - 1)
-    while b > 1 and (C % b or b * qpk > MAX_PREFILL_ROWS):
+    while b > 1 and (C % b or b * qpk > MAX_PAGED_ROWS):
         b //= 2
-    return b if C % b == 0 and b * qpk <= MAX_PREFILL_ROWS else None
+    return b if C % b == 0 and b * qpk <= MAX_PAGED_ROWS else None
 
 
-def ragged_prefill_block(s: int, qpk: int, d: int, page_size: int,
-                         num_slot_pages: int, *,
-                         min_cache: int = 0,
-                         kv_dtype=None,
-                         interpret: bool = False) -> Optional[int]:
-    """Static dispatch check for the ragged prefill kernel: returns the
+def ragged_paged_block(s: int, qpk: int, d: int, page_size: int,
+                       num_slot_pages: int, *,
+                       min_cache: int = 0,
+                       kv_dtype=None,
+                       interpret: bool = False) -> Optional[int]:
+    """Static dispatch check for the unified paged kernel: returns the
     q block size (tokens per grid program) or None for the XLA path.
 
-    Same territory rules as the paged decode gate, minus the s == 1
-    restriction it exists to lift: lane-aligned head dim, a page that
-    tiles sublanes (the page IS the K/V DMA unit), TPU-or-interpreter
-    backend, and the SAME per-slot-reach `min_cache` threshold — a
-    decode row served by a mixed step must take the same kernel-vs-XLA
-    path it would take in a decode-scan step on the same pool, or a
-    near-tie argmax could flip mid-stream when admission starts.
+    Kernel territory: lane-aligned head dim, a page that tiles sublanes
+    (the page IS the K/V DMA unit — 16 covers bf16/fp32, int8 pools
+    need the 32 int8 sublane tile), TPU-or-interpreter backend, and a
+    per-slot reach num_slot_pages * page_size of at least `min_cache`.
+    ONE gate for every phase: a decode row (s == 1) takes the same
+    kernel-vs-XLA decision it would take as a width-1 chunk of a mixed
+    step on the same pool, so a near-tie argmax can never flip when
+    admission starts mid-stream.
     """
     if not (interpret or jax.default_backend() == "tpu"):
         return None
     if s < 1 or d % 128 != 0:
         return None
-    # int8 pools need the int8 sublane tile (32); bf16/fp gets by on 16
-    # — same rule as the paged decode gate, so decode rows keep taking
-    # the same kernel-vs-XLA path in mixed and scan steps
     is_int8 = kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8
     sublane = 32 if is_int8 else 16
     if page_size < sublane or page_size % sublane != 0:
@@ -112,16 +130,17 @@ def ragged_prefill_block(s: int, qpk: int, d: int, page_size: int,
 # ---------------------------------------------------------------------------
 
 
-def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
-                    *rest, block_q, page_size, qpk, d, num_pages,
-                    sm_scale, split_boundary=True, quantized=False):
+def _paged_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
+                  *rest, block_q, page_size, qpk, d, num_pages,
+                  sm_scale, split_boundary=True, quantized=False):
     """Grid (chunk, group, q_block, page); the page dim carries the
     online-softmax state. Row r of the folded (block_q*qpk, d) q block
     is chunk token i*block_q + r // qpk (head fastest) at global
     position starts[c] + token; rows at tokens >= lens[c] are pad.
-    `quantized` (int8 KV pages, ISSUE 9): k/v arrive int8 with
-    per-(token, group) fp32 scale columns as two extra (page_size, 1)
-    operands, dequantized in-register before the unchanged fp32 math."""
+    `quantized` selects the int8-KV epilogue (ISSUE 9): k/v arrive int8
+    with per-(token, group) fp32 scale columns as two extra
+    (page_size, 1) operands, dequantized in-register before the
+    unchanged fp32 template math."""
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -135,9 +154,7 @@ def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
 
     @pl.when(j == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        _softmax_init(m_scr, l_scr, acc_scr)
 
     def _accum(masked):
         qb = q_ref[:].reshape(rows, d)
@@ -152,40 +169,29 @@ def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
             preferred_element_type=jnp.float32,
         ) * (sm_scale * LOG2E)
         if masked:
-            # causal + pad mask in one predicate: token t of the chunk
-            # sits at position start + t, may see cols <= start + t, and
-            # is pad when t >= len (pad rows mask EVERY column -> the
-            # finalize clamp emits exact zeros, the empty-slot contract)
-            tok = i * block_q + (
-                jax.lax.broadcasted_iota(jnp.int32, (rows, page_size), 0)
-                // qpk
+            # the shared causal predicate at the ragged-chunk
+            # parameterization: token t of the chunk sits at position
+            # start + t, may see cols <= start + t, and is pad when
+            # t >= len (pad rows mask EVERY column -> the finalize
+            # clamp emits exact zeros, the empty-slot contract).
+            # NEG_INF is a finite constant: a PAD row would degenerate
+            # to exp2(0)-everywhere garbage, so the finalize re-masks
+            # pad rows; valid rows always have a real max (page 0,
+            # col 0 is causal for every row), so their masked cells
+            # underflow to exact 0.
+            sc = jnp.where(
+                _causal_invalid(rows, page_size, qpk,
+                                start + i * block_q, j * page_size,
+                                valid_rows=clen - i * block_q),
+                NEG_INF, sc,
             )
-            col = j * page_size + jax.lax.broadcasted_iota(
-                jnp.int32, (rows, page_size), 1
-            )
-            # NEG_INF is a finite constant: a PAD row (every column
-            # masked) would degenerate to exp2(0)-everywhere garbage,
-            # so the finalize re-masks pad rows to exact zero; valid
-            # rows always have a real max (page 0, col 0 is causal for
-            # every row), so their masked cells underflow to exact 0.
-            invalid = (col > start + tok) | (tok >= clen)
-            sc = jnp.where(invalid, NEG_INF, sc)
-        m_prev = m_scr[:]  # (rows, 1)
-        m_cur = jnp.max(sc, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp2(m_prev - m_new)
-        p = jnp.exp2(sc - m_new)
-        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         if quantized:
             vb = v_ref[:].reshape(page_size, d).astype(jnp.float32) \
                 * vs_ref[:].reshape(page_size, 1)
+            _softmax_accum(sc, vb, m_scr, l_scr, acc_scr)
         else:
-            vb = v_ref[:].reshape(page_size, d)
-            p = p.astype(v_ref.dtype)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p, vb, preferred_element_type=jnp.float32,
-        )
-        m_scr[:] = m_new
+            _softmax_accum(sc, v_ref[:].reshape(page_size, d), m_scr,
+                           l_scr, acc_scr, p_dtype=v_ref.dtype)
 
     # last position this q block's VALID rows can attend: the block's
     # last valid token (or nothing when the block is all pad)
@@ -212,8 +218,7 @@ def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
 
     @pl.when(j == num_pages - 1)
     def _finalize():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        out = acc_scr[:] / l
+        out, _ = _softmax_finalize(l_scr, acc_scr)
         # pad rows accumulated garbage above (see the mask note): pin
         # them to the exact-zero contract of the XLA twin
         row_tok = i * block_q + jax.lax.broadcasted_iota(
@@ -222,8 +227,8 @@ def _prefill_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
         o_ref[:] = out.astype(o_ref.dtype).reshape(o_ref.shape)
 
 
-def _prefill_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
-                    block_q, interpret, k_scales=None, v_scales=None):
+def _paged_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
+                  block_q, interpret, k_scales=None, v_scales=None):
     """q: (nc, C, g, qpk, d); k/v_pages: (P, page_size, g, d);
     page_table: (nc, max_pages) int32; starts/chunk_lens: (nc,) int32.
     k/v_scales (int8 pools only): (P, page_size, g) fp32 per-(token,
@@ -238,12 +243,12 @@ def _prefill_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
 
     qf = q.transpose(0, 2, 1, 3, 4).reshape(nc, g, C * qpk, d)
     # rows below one fp32 sublane tile: launch q/o in fp32 (the small-
-    # memref Mosaic workaround shared with the decode kernels)
+    # memref Mosaic workaround shared with the dense decode kernel)
     out_dtype = q.dtype if rows % 8 == 0 else jnp.float32
     qf = qf.astype(out_dtype)
 
     kernel = functools.partial(
-        _prefill_kernel, block_q=block_q, page_size=page_size, qpk=qpk,
+        _paged_kernel, block_q=block_q, page_size=page_size, qpk=qpk,
         d=d, num_pages=max_pages, sm_scale=1.0 / (d ** 0.5),
         split_boundary=not interpret, quantized=quantized,
     )
@@ -311,41 +316,70 @@ def _prefill_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
 
 
 # ---------------------------------------------------------------------------
-# XLA reference (gather pages -> dense ragged-causal math)
+# XLA reference: ONE gather-pages-then-dense definition (ISSUE 18
+# satellite — the former per-variant oracle twins, paged decode and
+# ragged prefill each with a quantized sibling, collapsed)
 # ---------------------------------------------------------------------------
 
 
-def _xla_ragged_prefill(q, k_pages, v_pages, page_table, starts,
-                        chunk_lens):
+def _xla_attend(q, k, v, row_pos, row_valid=None):
+    """The dense masked-softmax core every XLA attention twin shares:
+    q (b, s, g, qpk, d) against dense k/v (b, g, T, d). `row_pos` is the
+    last attendable cache position per folded row — (rows,) when shared
+    across the batch (the dense decode twin), (b, rows) when ragged per
+    sequence (the paged twin). `row_valid` (b, rows), optional: rows
+    where False pin to exact zero (the pad-row / empty-chunk contract);
+    None skips the select entirely so the dense twin's HLO is
+    unchanged. Masked columns multiply unwritten cache by an exact fp 0,
+    so the allocated width never leaks into values."""
+    b, s, g, qpk, d = q.shape
+    T = k.shape[2]
+    qb = q.transpose(0, 2, 1, 3, 4).reshape(b, g, s * qpk, d)
+    scores = jax.lax.dot_general(
+        qb, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ) * (1.0 / jnp.sqrt(d).astype(jnp.float32))  # (b, g, s*qpk, T)
+    if row_pos.ndim == 1:
+        mask = jnp.arange(T)[None, :] > row_pos[:, None]
+        scores = jnp.where(mask[None, None], jnp.finfo(jnp.float32).min,
+                           scores)
+    else:
+        mask = jnp.arange(T)[None, None, :] > row_pos[:, :, None]
+        scores = jnp.where(mask[:, None], jnp.finfo(jnp.float32).min,
+                           scores)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jax.lax.dot_general(
+        probs, v, (((3,), (2,)), ((0, 1), (0, 1))),
+    )  # (b, g, s*qpk, d)
+    if row_valid is not None:
+        out = jnp.where(row_valid[:, None, :, None], out,
+                        jnp.zeros((), out.dtype))
+    return out.reshape(b, g, s, qpk, d).transpose(0, 2, 1, 3, 4)
+
+
+def _xla_paged_reference(q, k_pages, v_pages, page_table, starts,
+                         chunk_lens, k_scales=None, v_scales=None):
     """Gather each chunk's pages into the dense view, then the
-    `_xla_paged_decode` op sequence generalized to ragged multi-row
-    chunks — the shapes-and-math twin of the kernel, used off-TPU and by
-    the parity tests. Masked columns multiply unwritten pool pages by an
-    exact fp 0; pad rows (token >= chunk_lens) are pinned to the
-    kernel's exact-zero output."""
+    `_xla_attend` core with ragged per-chunk row positions — the
+    shapes-and-math twin of the kernel, the off-TPU serving path, and
+    the ONE parity-test oracle. kv dtype is a parameter here too:
+    int8 pools pass their scale pools and dequantize to the fp32 view
+    first (the quantize-then-dequantize oracle — the same fp32 values
+    the kernel's in-register epilogue feeds the same math). Pad rows
+    (token >= chunk_lens) pin to the kernel's exact-zero output."""
     nc, C, g, qpk, d = q.shape
+    if k_scales is not None:
+        k_pages = k_pages.astype(jnp.float32) * k_scales[..., None]
+        v_pages = v_pages.astype(jnp.float32) * v_scales[..., None]
     page_size = k_pages.shape[1]
     max_pages = page_table.shape[1]
     T = max_pages * page_size
     k = k_pages[page_table].reshape(nc, T, g, d).transpose(0, 2, 1, 3)
     v = v_pages[page_table].reshape(nc, T, g, d).transpose(0, 2, 1, 3)
-    qb = q.transpose(0, 2, 1, 3, 4).reshape(nc, g, C * qpk, d)
-    scores = jax.lax.dot_general(
-        qb, k, (((3,), (3,)), ((0, 1), (0, 1))),
-        preferred_element_type=jnp.float32,
-    ) * (1.0 / jnp.sqrt(d).astype(jnp.float32))  # (nc, g, C*qpk, T)
     tok = jnp.arange(C * qpk) // qpk  # (rows,)
     row_pos = starts[:, None] + tok[None, :]  # (nc, rows)
-    mask = jnp.arange(T)[None, None, :] > row_pos[:, :, None]
-    scores = jnp.where(mask[:, None], jnp.finfo(jnp.float32).min, scores)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jax.lax.dot_general(
-        probs, v, (((3,), (2,)), ((0, 1), (0, 1))),
-    )  # (nc, g, C*qpk, d)
     row_valid = tok[None, :] < chunk_lens[:, None]  # (nc, rows)
-    out = jnp.where(row_valid[:, None, :, None], out,
-                    jnp.zeros((), out.dtype))
-    return out.reshape(nc, g, C, qpk, d).transpose(0, 2, 1, 3, 4)
+    return _xla_attend(q, k, v, row_pos, row_valid=row_valid)
 
 
 def scatter_chunk_kv(k_new, v_new, k_pages, v_pages, page_table, starts,
@@ -355,7 +389,9 @@ def scatter_chunk_kv(k_new, v_new, k_pages, v_pages, page_table, starts,
     page_size] at offset (starts+t) % page_size. Pad rows are routed to
     pool page 0 — the dead null page every table parks unowned entries
     on — so they can never touch a live slot's cache. Returns the
-    updated pools.
+    updated pools. The decode scan's single-token write is the C == 1
+    case of this one scatter (retired slots carry all-null table rows,
+    so their row lands on the null page like a pad row would).
 
     Int8 pools (k_pages.dtype == int8; pass the matching k/v_scales
     pools): this IS the quantize-at-write point — k_new/v_new arrive fp,
@@ -391,19 +427,7 @@ def scatter_chunk_kv(k_new, v_new, k_pages, v_pages, page_table, starts,
     return k_pages, v_pages
 
 
-def _xla_ragged_prefill_quant(q, k_pages, v_pages, k_scales, v_scales,
-                              page_table, starts, chunk_lens):
-    """Quantize-then-dequantize oracle for the int8 ragged prefill
-    kernel: dequantize the int8 pools against their per-(token, group)
-    scale pools to the fp32 view, then the exact `_xla_ragged_prefill`
-    op sequence. Off-TPU this IS the engine's mixed-step serving path,
-    so the oracle and the fallback can never drift."""
-    kf = k_pages.astype(jnp.float32) * k_scales[..., None]
-    vf = v_pages.astype(jnp.float32) * v_scales[..., None]
-    return _xla_ragged_prefill(q, kf, vf, page_table, starts, chunk_lens)
-
-
-def ragged_paged_prefill(
+def ragged_paged_attention(
     q: jnp.ndarray,  # (nc, C, g, qpk, d) — C = padded chunk width
     k_new: jnp.ndarray,  # (nc, C, g, d) — this chunk's K (RoPE applied)
     v_new: jnp.ndarray,  # (nc, C, g, d)
@@ -418,18 +442,23 @@ def ragged_paged_prefill(
     k_scales: Optional[jnp.ndarray] = None,  # (num_pages, page_size, g)
     v_scales: Optional[jnp.ndarray] = None,  # fp32; required for int8
 ):
-    """Ragged paged prefill, one pass: scatter the chunk's own K/V into
-    its slot's pages, then causal attention of chunk token t (global
-    position starts + t) over cache positions 0..starts+t — served by
-    the Pallas kernel on TPU (or under the interpreter) and by the
-    gather-pages twin elsewhere. A decode row is the chunk_lens == 1
-    special case. Returns (out (nc, C, g, qpk, d), k_pages, v_pages);
-    pad rows (t >= chunk_lens) are exact zeros.
+    """THE paged attention entry point, one pass for every phase:
+    scatter the chunk's own K/V into its slot's pages, then causal
+    attention of chunk token t (global position starts + t) over cache
+    positions 0..starts+t — served by the Pallas kernel on TPU (or
+    under the interpreter) and by the gather-pages twin elsewhere.
 
-    Int8 pools (ISSUE 9): pass the fp32 scale pools too — the scatter
-    quantizes the chunk's fp K/V at write time, attention dequantizes
-    in-register (kernel) or on the gathered view (XLA twin), and the
-    return grows to (out, k_pages, v_pages, k_scales, v_scales)."""
+    Phase is a shape: a decode row is chunk_lens == 1 at starts ==
+    lengths (C == 1 in the engine's decode scan; any C in a mixed
+    round), a prefill span is chunk_lens in 2..C, an idle slot is
+    chunk_lens == 0. Returns (out (nc, C, g, qpk, d), k_pages,
+    v_pages); pad rows (t >= chunk_lens) are exact zeros.
+
+    kv dtype is a parameter (ISSUE 9): int8 pools pass the fp32 scale
+    pools too — the scatter quantizes the chunk's fp K/V at write time,
+    attention dequantizes in-register (kernel) or on the gathered view
+    (XLA twin), and the return grows to (out, k_pages, v_pages,
+    k_scales, v_scales)."""
     nc, C, g, qpk, d = q.shape
     quantized = k_pages.dtype == jnp.int8
     if quantized:
@@ -443,23 +472,21 @@ def ragged_paged_prefill(
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
-        bq = ragged_prefill_block(C, qpk, d, k_pages.shape[1],
-                                  page_table.shape[1],
-                                  min_cache=min_cache,
-                                  kv_dtype=k_pages.dtype,
-                                  interpret=interpret)
+        bq = ragged_paged_block(C, qpk, d, k_pages.shape[1],
+                                page_table.shape[1],
+                                min_cache=min_cache,
+                                kv_dtype=k_pages.dtype,
+                                interpret=interpret)
         if bq is not None:
-            out = _prefill_pallas(q, k_pages, v_pages, page_table,
-                                  starts, chunk_lens, bq, interpret,
-                                  k_scales=k_scales, v_scales=v_scales)
+            out = _paged_pallas(q, k_pages, v_pages, page_table,
+                                starts, chunk_lens, bq, interpret,
+                                k_scales=k_scales, v_scales=v_scales)
             if quantized:
                 return out, k_pages, v_pages, k_scales, v_scales
             return out, k_pages, v_pages
+    out = _xla_paged_reference(q, k_pages, v_pages, page_table, starts,
+                               chunk_lens, k_scales=k_scales,
+                               v_scales=v_scales)
     if quantized:
-        out = _xla_ragged_prefill_quant(q, k_pages, v_pages, k_scales,
-                                        v_scales, page_table, starts,
-                                        chunk_lens)
         return out, k_pages, v_pages, k_scales, v_scales
-    out = _xla_ragged_prefill(q, k_pages, v_pages, page_table, starts,
-                              chunk_lens)
     return out, k_pages, v_pages

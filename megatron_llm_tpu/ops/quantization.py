@@ -9,12 +9,12 @@ stack uses and the ONE rounding/scale convention they share:
   global page pools store K/V as int8 with a per-(token, group) fp32
   scale living in a parallel scale pool (num_pages, page_size, g) —
   ~4 bytes of scale per 2 x head_dim bytes of data. Quantization
-  happens AT WRITE TIME (the prefill/decode scatter paths,
-  ops/prefill_attention.scatter_chunk_kv and the paged decode branch of
-  models/attention.py); the paged kernels dequantize in-register inside
-  their exp2-online-softmax loops (fp32 accumulation unchanged), and
-  the XLA gather-pages twins dequantize the gathered view — the same
-  values either way, so the twins stay the CPU oracles.
+  happens AT WRITE TIME through the ONE scatter path
+  (ops/prefill_attention.scatter_chunk_kv — decode rows are its C == 1
+  case since ISSUE 18); the ragged paged kernel dequantizes in-register
+  inside its exp2-online-softmax loop (fp32 accumulation unchanged),
+  and the XLA gather-pages twin dequantizes the gathered view — the
+  same values either way, so the twin stays the CPU oracle.
 - **Weight-only int8 decode matmuls** (`quantize_weight` per OUTPUT
   channel, `qdot` at the apply site): a one-shot transform of the fp
   decode param tree (GPTModel.prepare_decode_params(quantize_int8=

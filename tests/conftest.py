@@ -19,6 +19,14 @@ _vm = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_vm)
 _vm.force_virtual_cpu_devices(8)
 
+# NOTE: do NOT enable the persistent compilation cache
+# (JAX_COMPILATION_CACHE_DIR) here. It would halve single-core tier-1
+# wall time (suites rebuild byte-identical tiny engines), but THIS
+# jaxlib's CPU executable deserialization heap-corrupts on some
+# programs (glibc "corrupted size vs. prev_size" abort, reproduced on
+# the disagg bench harness's multi-replica engines) — re-audit on a
+# jaxlib bump.
+
 import jax  # noqa: E402
 
 # The axon sitecustomize (see /root/.axon_site) sets jax_platforms=axon,cpu

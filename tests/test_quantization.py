@@ -1,13 +1,13 @@
 """Int8 KV pages + weight-only quantized decode matmuls (ISSUE 9).
 
-Kernel layer (REAL Pallas kernels through the interpreter on CPU, the
-conftest policy shared with every kernel suite): the quantized paged
-decode and ragged prefill variants are pinned against the
-quantize-then-dequantize XLA oracles across MHA/GQA/MQA x ragged
-lengths x partial pages, the int8 gate rules (32-sublane page tiling),
-the quantize-at-write scatter (scales land with their data, pad rows on
-the null page), and decode-row degeneracy (a width-1 quantized chunk
-reproduces the quantized paged decode).
+Convention layer: the ONE symmetric round-to-nearest int8 scheme
+(scale = amax/127, error <= scale/2, zero rows round-trip exactly) that
+both the KV pools and the weight-only decode matmuls share. The KERNEL
+pins for int8 paged attention (dequant-oracle parity, the 32-sublane
+gate, scatter-with-scales, decode-row degeneracy) live with the rest of
+the paged matrix in tests/test_paged_attention.py since ISSUE 18
+collapsed the quantized variants into THE ragged paged kernel's kv
+dtype parameter.
 
 Engine layer (tiny fp32 model -> the XLA twins, the engine-suite
 pattern): an int8 engine run asserts bounded teacher-forced
@@ -21,37 +21,20 @@ the flag returns the exact old tree — pinned here so the parity suites
 keep meaning what they say).
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from conftest import kernel_interpret_mode
 from megatron_llm_tpu.analysis.contracts import get_contract, variants
 from megatron_llm_tpu.config import tiny_config
 from megatron_llm_tpu.inference.engine import DecodeEngine
 from megatron_llm_tpu.models import LlamaModel
-from megatron_llm_tpu.ops.decode_attention import (
-    _xla_paged_decode_quant,
-    paged_decode_attention,
-    paged_decode_attn_block,
-)
-from megatron_llm_tpu.ops.prefill_attention import (
-    _xla_ragged_prefill_quant,
-    ragged_paged_prefill,
-    ragged_prefill_block,
-    scatter_chunk_kv,
-)
 from megatron_llm_tpu.ops.quantization import (
     dequantize_rows,
-    quantize_decode_layers,
     quantize_rows,
     quantize_weight,
 )
-
-INTERPRET = kernel_interpret_mode()
 
 
 # ---------------------------------------------------------------------------
@@ -81,209 +64,6 @@ class TestQuantizeRows:
         data, scale = quantize_rows(x)
         assert not bool(jnp.any(jnp.isnan(scale)))
         assert bool(jnp.all(dequantize_rows(data, scale) == 0.0))
-
-
-# ---------------------------------------------------------------------------
-# Quantized paged decode kernel vs the dequantize oracle
-# ---------------------------------------------------------------------------
-
-
-def _quant_pool_case(slots, g, qpk, d, page_size, pages_per_slot,
-                     seed=0):
-    """Random fp pools quantized per (page row, group) + a page table
-    of distinct shuffled pages (page 0 = null)."""
-    num_pages = 1 + slots * pages_per_slot
-    ks = jax.random.split(jax.random.key(seed), 3)
-    q = jax.random.normal(ks[0], (slots, 1, g, qpk, d), jnp.float32)
-    kf = jax.random.normal(ks[1], (num_pages, page_size, g, d),
-                           jnp.float32)
-    vf = jax.random.normal(ks[2], (num_pages, page_size, g, d),
-                           jnp.float32)
-    kq, ksc = quantize_rows(kf)
-    vq, vsc = quantize_rows(vf)
-    rs = np.random.RandomState(seed)
-    perm = rs.permutation(num_pages - 1) + 1
-    pt = jnp.asarray(perm.reshape(slots, pages_per_slot), jnp.int32)
-    return q, kq, vq, ksc, vsc, pt
-
-
-CASES = [
-    pytest.param(4, 1, id="mha"),
-    pytest.param(2, 2, id="gqa"),
-    pytest.param(1, 8, id="mqa"),
-]
-
-
-class TestQuantPagedDecode:
-    @pytest.mark.parametrize("g,qpk", CASES)
-    def test_matches_dequant_oracle_across_ragged_lengths(self, g, qpk):
-        """Per-slot lengths at page starts/ends and mid-page (partial
-        last page) in ONE launch must each agree with the
-        quantize-then-dequantize oracle — the in-register dequant is
-        numerically the same fp32 operand."""
-        q, kq, vq, ksc, vsc, pt = _quant_pool_case(3, g, qpk, 128, 32, 4)
-        for lengths in ([1, 33, 128], [32, 64, 65], [31, 96, 63],
-                        [128, 1, 127]):
-            lengths = jnp.asarray(lengths, jnp.int32)
-            out = paged_decode_attention(
-                q, kq, vq, pt, lengths, use_pallas=True,
-                interpret=INTERPRET, k_scales=ksc, v_scales=vsc)
-            ref = _xla_paged_decode_quant(q, kq, vq, ksc, vsc, pt,
-                                          lengths)
-            np.testing.assert_allclose(
-                np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5,
-                err_msg=str(lengths))
-
-    def test_empty_slot_exact_zero(self):
-        q, kq, vq, ksc, vsc, pt = _quant_pool_case(2, 2, 2, 128, 32, 2)
-        lengths = jnp.asarray([0, 40], jnp.int32)
-        out = paged_decode_attention(
-            q, kq, vq, pt, lengths, use_pallas=True, interpret=INTERPRET,
-            k_scales=ksc, v_scales=vsc)
-        assert bool(jnp.all(out[0] == 0.0))
-
-    def test_int8_gate_needs_32_sublane_pages(self):
-        """page_size 16 serves bf16 but NOT int8 (the int8 sublane
-        tile is 32) — ineligible shapes must fall back to the oracle,
-        not mis-launch."""
-        assert paged_decode_attn_block(
-            1, 2, 128, 16, 4, interpret=True) == 16
-        assert paged_decode_attn_block(
-            1, 2, 128, 16, 4, kv_dtype=jnp.int8, interpret=True) is None
-        assert paged_decode_attn_block(
-            1, 2, 128, 32, 4, kv_dtype=jnp.int8, interpret=True) == 32
-        # and the entry point serves the ineligible shape via the twin
-        q, kq, vq, ksc, vsc, pt = _quant_pool_case(2, 2, 2, 128, 16, 4)
-        lengths = jnp.asarray([5, 20], jnp.int32)
-        out = paged_decode_attention(
-            q, kq, vq, pt, lengths, use_pallas=True, interpret=INTERPRET,
-            k_scales=ksc, v_scales=vsc)
-        ref = _xla_paged_decode_quant(q, kq, vq, ksc, vsc, pt, lengths)
-        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
-
-    def test_scales_required_for_int8(self):
-        q, kq, vq, ksc, vsc, pt = _quant_pool_case(2, 2, 2, 128, 32, 2)
-        with pytest.raises(AssertionError, match="k_scales"):
-            paged_decode_attention(q, kq, vq, pt,
-                                   jnp.asarray([1, 1], jnp.int32))
-
-
-# ---------------------------------------------------------------------------
-# Quantized ragged prefill kernel: scatter-with-scales + attention
-# ---------------------------------------------------------------------------
-
-
-def _quant_prefill_case(nc, g, qpk, d, page_size, pages_per_slot,
-                        seed=0):
-    num_pages = 1 + nc * pages_per_slot
-    ks = jax.random.split(jax.random.key(seed), 3)
-    kp = jnp.zeros((num_pages, page_size, g, d), jnp.int8)
-    vp = jnp.zeros_like(kp)
-    kps = jnp.zeros((num_pages, page_size, g), jnp.float32)
-    vps = jnp.zeros_like(kps)
-    rs = np.random.RandomState(seed)
-    perm = rs.permutation(num_pages - 1) + 1
-    pt = jnp.asarray(perm.reshape(nc, pages_per_slot), jnp.int32)
-    return ks, kp, vp, kps, vps, pt
-
-
-class TestQuantRaggedPrefill:
-    @pytest.mark.parametrize("g,qpk", CASES)
-    def test_matches_dequant_oracle_across_offsets(self, g, qpk):
-        """Chunks at page-aligned and mid-page offsets, full and
-        ragged (pad-rowed) widths: scatter quantizes at write, the
-        kernel dequantizes in-register, and both must agree with the
-        dequantize oracle on the pools the scatter just wrote."""
-        d, ps = 128, 32
-        for starts, lens, C in (([0, 0], [8, 8], 8),
-                                ([40, 7], [8, 3], 8),
-                                ([0, 90], [1, 6], 8)):
-            keys, kp, vp, kps, vps, pt = _quant_prefill_case(
-                2, g, qpk, d, ps, 4)
-            q = jax.random.normal(keys[0], (2, C, g, qpk, d), jnp.float32)
-            kn = jax.random.normal(keys[1], (2, C, g, d), jnp.float32)
-            vn = jax.random.normal(keys[2], (2, C, g, d), jnp.float32)
-            starts = jnp.asarray(starts, jnp.int32)
-            lens = jnp.asarray(lens, jnp.int32)
-            out, kp2, vp2, kps2, vps2 = ragged_paged_prefill(
-                q, kn, vn, kp, vp, pt, starts, lens, use_pallas=True,
-                interpret=INTERPRET, k_scales=kps, v_scales=vps)
-            ref = _xla_ragged_prefill_quant(q, kp2, vp2, kps2, vps2, pt,
-                                            starts, lens)
-            np.testing.assert_allclose(
-                np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5,
-                err_msg=f"starts={starts} lens={lens}")
-
-    def test_scatter_quantizes_with_scales_in_place(self):
-        """The int8 scatter writes data AND scales at the same
-        [page, offset]; rows round-trip within scale/2; pad rows land
-        on the null page (data + scale both) and no foreign page is
-        touched."""
-        g, qpk, d, ps = 2, 1, 128, 32
-        keys, kp, vp, kps, vps, pt = _quant_prefill_case(2, g, qpk, d,
-                                                         ps, 2)
-        C = 8
-        kn = jax.random.normal(keys[1], (2, C, g, d), jnp.float32)
-        vn = jax.random.normal(keys[2], (2, C, g, d), jnp.float32)
-        starts = jnp.asarray([0, 3], jnp.int32)
-        lens = jnp.asarray([8, 5], jnp.int32)  # chunk 1: 3 pad rows
-        kp2, vp2, kps2, vps2 = scatter_chunk_kv(
-            kn, vn, kp, vp, pt, starts, lens, k_scales=kps,
-            v_scales=vps)
-        # chunk 0 token t at page pt[0, t//ps] offset t
-        deq = dequantize_rows(kp2[pt[0, 0]], kps2[pt[0, 0]])
-        err = jnp.abs(deq[:8] - kn[0])
-        assert bool(jnp.all(err <= kps2[pt[0, 0], :8, :, None] * 0.5
-                            + 1e-7))
-        # pad rows of chunk 1 (tokens 5..7) went to the null page
-        assert bool(jnp.any(kp2[0] != 0)) and bool(jnp.any(kps2[0] != 0))
-        # untouched foreign slot pages stay zero past chunk 1's reach
-        own = {int(pt[1, 0])}
-        other = [p for p in range(1, kp2.shape[0])
-                 if p not in own | {int(pt[0, 0])}]
-        assert bool(jnp.all(kps2[jnp.asarray(other)] == 0))
-
-    def test_decode_row_degeneracy_quantized(self):
-        """A width-1 quantized chunk must reproduce the quantized
-        paged decode path on the same pools — decode rows and prefill
-        chunks share one quantization convention AND one math."""
-        g, qpk, d, ps = 2, 2, 128, 32
-        keys, kp, vp, kps, vps, pt = _quant_prefill_case(2, g, qpk, d,
-                                                         ps, 2)
-        # pre-fill 40 positions per slot through the quantized scatter
-        pre = 40
-        kn = jax.random.normal(keys[1], (2, pre, g, d), jnp.float32)
-        vn = jax.random.normal(keys[2], (2, pre, g, d), jnp.float32)
-        zeros = jnp.zeros((2,), jnp.int32)
-        kp, vp, kps, vps = scatter_chunk_kv(
-            kn, vn, kp, vp, pt, zeros, jnp.full((2,), pre, jnp.int32),
-            k_scales=kps, v_scales=vps)
-        q = jax.random.normal(keys[0], (2, 1, g, qpk, d), jnp.float32)
-        k1 = jax.random.normal(jax.random.key(9), (2, 1, g, d),
-                               jnp.float32)
-        v1 = jax.random.normal(jax.random.key(10), (2, 1, g, d),
-                               jnp.float32)
-        starts = jnp.full((2,), pre, jnp.int32)
-        ones = jnp.ones((2,), jnp.int32)
-        chunk_out, kp2, vp2, kps2, vps2 = ragged_paged_prefill(
-            q, k1, v1, kp, vp, pt, starts, ones, use_pallas=True,
-            interpret=INTERPRET, k_scales=kps, v_scales=vps)
-        dec_out = paged_decode_attention(
-            q, kp2, vp2, pt, starts + 1, use_pallas=True,
-            interpret=INTERPRET, k_scales=kps2, v_scales=vps2)
-        np.testing.assert_allclose(
-            np.asarray(chunk_out[:, 0]), np.asarray(dec_out[:, 0]),
-            rtol=1e-6, atol=1e-6)
-
-    def test_int8_gate_needs_32_sublane_pages(self):
-        assert ragged_prefill_block(8, 1, 128, 16, 4,
-                                    interpret=True) is not None
-        assert ragged_prefill_block(8, 1, 128, 16, 4,
-                                    kv_dtype=jnp.int8,
-                                    interpret=True) is None
-        assert ragged_prefill_block(8, 1, 128, 32, 4,
-                                    kv_dtype=jnp.int8,
-                                    interpret=True) is not None
 
 
 # ---------------------------------------------------------------------------

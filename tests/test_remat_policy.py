@@ -117,20 +117,32 @@ def test_block_vs_uniform_bitwise_identical():
 
 def test_reference_granularity_spelling_parity():
     """The reference --recompute_granularity spellings route through the
-    same policies (selective no longer degrades to no-remat)."""
-    cfg = _base_cfg()
+    same policies (selective no longer degrades to no-remat): the
+    granularity-spelled config LOWERS to byte-identical HLO as the
+    remat_policy-spelled one — a stronger pin than value parity (which
+    test_policies_bitwise_identical already gives every policy), at
+    trace cost instead of three XLA compiles."""
     rs = np.random.RandomState(2)
     tokens = jnp.asarray(rs.randint(0, 256, (2, 64)), jnp.int32)
     labels = jnp.asarray(rs.randint(0, 256, (2, 64)), jnp.int32)
     rng = jax.random.key(13)
 
-    ref = _loss_and_grads(cfg, tokens, labels, rng)  # granularity None
+    def lowered(cfg):
+        model = LlamaModel(cfg)
+        params = model.init(jax.random.key(0))
+
+        def loss(p):
+            return model.loss(p, tokens, labels, dropout_rng=rng,
+                              deterministic=False)
+
+        return jax.jit(jax.value_and_grad(loss)).lower(params).as_text()
+
     for gran in ("selective", "full"):
-        out = _loss_and_grads(
-            dataclasses.replace(cfg, recompute_granularity=gran),
-            tokens, labels, rng,
-        )
-        _assert_bitwise(ref, out, gran)
+        spelled = lowered(_base_cfg(recompute_granularity=gran))
+        direct = lowered(_base_cfg(remat_policy=gran))
+        assert spelled == direct, (
+            f"recompute_granularity={gran} lowers differently from "
+            f"remat_policy={gran}")
 
 
 # ---------------------------------------------------------------------------

@@ -547,3 +547,63 @@ class TestRepoGate:
              "justification": "  "}]}))
         with pytest.raises(ValueError, match="justification"):
             load_cost_baseline(str(p))
+
+
+class TestOnePagedEntryPoint:
+    """ISSUE 18's structural guarantee: `ops/` exposes exactly ONE
+    paged-attention entry point. The six-way fork collapsed into
+    `ragged_paged_attention`; this guard keeps a seventh variant from
+    growing back under a new name."""
+
+    def test_ops_exposes_exactly_one_paged_attention_entry(self):
+        import ast
+
+        ops_dir = os.path.join(_REPO, "megatron_llm_tpu", "ops")
+        public_paged = []
+        for fname in sorted(os.listdir(ops_dir)):
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(ops_dir, fname), encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=fname)
+            for node in tree.body:
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                name = node.name
+                if name.startswith("_"):
+                    continue
+                if "paged" in name and ("attention" in name
+                                        or "prefill" in name
+                                        or "decode" in name):
+                    public_paged.append(f"{fname}:{name}")
+        assert public_paged == [
+            "prefill_attention.py:ragged_paged_attention"], public_paged
+
+    def test_retired_kernel_names_are_gone(self):
+        """The replaced entry points must not linger anywhere in the
+        package — a stale import would resurrect the fork silently."""
+        retired = ("paged_decode_attention", "ragged_paged_prefill",
+                   "ragged_prefill_block", "paged_decode_attn_block",
+                   "_xla_paged_decode", "_xla_ragged_prefill")
+        pkg = os.path.join(_REPO, "megatron_llm_tpu")
+        hits = []
+        for root, _, files in os.walk(pkg):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                with open(path, encoding="utf-8") as fh:
+                    src = fh.read()
+                for name in retired:
+                    if name in src:
+                        hits.append(f"{os.path.relpath(path, _REPO)}: "
+                                    f"{name}")
+        assert not hits, hits
+
+    def test_ops_exports_the_one_entry(self):
+        from megatron_llm_tpu import ops
+
+        assert hasattr(ops, "ragged_paged_attention")
+        assert hasattr(ops, "ragged_paged_block")
+        for legacy in ("paged_decode_attention", "ragged_paged_prefill"):
+            assert not hasattr(ops, legacy), legacy
