@@ -1324,6 +1324,183 @@ def run_kernel_unify(slots=8):
         chunk=128, op_T=512, op_page_size=64)
 
 
+def longcontext_stats(model, params, *, window, slots=2, page_size=16,
+                      max_context=192, page_budget=None,
+                      dense_page_budget=None, vocab_size=256,
+                      long_prompt=24, long_gen=72, short_prompt=8,
+                      short_gen=8, n_short=3, chunk=None):
+    """The `extra.serving.longcontext` row (ISSUE 19): sliding-window
+    serving vs dense on mixed long + short traffic.
+
+    Three engines off ONE param init: DENSE (no window, full page
+    reservation — the pre-window cost model), WINDOWED with
+    out-of-window page reclamation ON (the fast path: admission prices
+    O(window) pages, the frontier tops up lazily, pages wholly behind
+    every live window recycle mid-flight), and the same windowed engine
+    with reclamation OFF (mask-only) as the in-row control — greedy
+    token streams AND logprobs are asserted BITWISE on == off, because
+    the clamped kernel never reads a reclaimed page by construction.
+    The windowed engine runs inside `page_budget` (a pool the dense
+    engine's reservation could NOT serve the same mix through); the
+    dense engine gets the full reservation so the comparison is
+    fast-path-in-a-small-pool vs old-path-in-a-big-pool.
+
+    Capacity columns are LIVE: peak pages per slot sampled from the
+    slot frontiers (mapped - reclaimed) while the traffic drains, the
+    reclaim counter from the engine, and the admission bound from
+    `_window_slot_pages`. Decode KV read bytes/token is MODELED from
+    the kernel's double-ended page clamp (pages touched at length L =
+    L//ps - max(0, L - W + 1)//ps + 1; dense reads every page) times
+    the live pool's bytes/token — the DMA grid skips out-of-window
+    pages wholly, so the model IS the kernel's read set; wall-clock
+    kernel numbers are the TPU artifact run's, this harness also runs
+    on the CPU XLA twin in tier-1 (tests/test_window_serving.py).
+    """
+    import dataclasses
+    import threading
+
+    import numpy as np
+
+    from megatron_llm_tpu.inference.engine import DecodeEngine
+
+    chunk = chunk or page_size
+    # one long-context-capable config family, one init: params are
+    # window- and length-independent (rotary tables come from the
+    # config at call time), so every engine below shares `params` and
+    # stream diffs isolate the window machinery alone.
+    pos = max(model.cfg.max_position_embeddings, max_context)
+    base_cfg = dataclasses.replace(
+        model.cfg, max_position_embeddings=pos,
+        seq_length=max(model.cfg.seq_length, max_context))
+    dense_model = type(model)(base_cfg)
+    win_model = type(model)(dataclasses.replace(
+        base_cfg, attention_window_size=window))
+
+    rs = np.random.RandomState(0)
+    long_spec = (list(rs.randint(2, vocab_size, long_prompt)), long_gen)
+    specs = [long_spec] + [
+        (list(rs.randint(2, vocab_size, short_prompt)), short_gen)
+        for _ in range(n_short)]
+
+    def run(eng):
+        """Drain the mix; return (streams, peak live pages per slot)."""
+        reqs = [eng.submit(list(p), g, top_k=1, return_log_probs=True)
+                for p, g in specs]
+        peak = 0
+        done = threading.Event()
+
+        def sample():
+            nonlocal peak
+            while not done.is_set():
+                live = max((s.mapped - s.reclaimed)
+                           for s in eng._slots)
+                peak = max(peak, live)
+                done.wait(0.001)
+
+        th = threading.Thread(target=sample, daemon=True)
+        th.start()
+        try:
+            eng.drain()
+        finally:
+            done.set()
+            th.join()
+        return [r.result(300) for r in reqs], peak
+
+    def build(mdl, **over):
+        kw = dict(slots=slots, page_size=page_size,
+                  max_context=max_context, prefill_chunk_tokens=chunk,
+                  vocab_size=vocab_size, termination_id=None)
+        kw.update(over)
+        return DecodeEngine(mdl, params, **kw)
+
+    # engines run SEQUENTIALLY and release their pools before the next
+    # one allocates — at bench scale two full-reservation pools do not
+    # coexist in HBM.
+    dense = build(dense_model, page_budget=dense_page_budget)
+    _, dense_peak = run(dense)
+    dense_pool = dense.num_pages - 1
+    dense.stop()
+    del dense
+
+    win = build(win_model, page_budget=page_budget)
+    win_streams, win_peak = run(win)
+    bpt = win.kv_bytes_per_token()
+    win_pool = win.num_pages - 1
+    win_bound = win._window_slot_pages()
+    win_reclaimed = win._window_reclaimed
+    c = win.counters()
+    win.stop()
+    del win
+
+    # mask-only control: same window math, no reclamation — it prices
+    # the FULL reach at admission, so it runs in the dense engine's
+    # reservation (that is the point: without reclamation the small
+    # pool is not serviceable).
+    mask_only = build(win_model, window_reclaim=False,
+                      page_budget=dense_page_budget)
+    off_streams, _ = run(mask_only)
+    mask_only.stop()
+    del mask_only
+    assert win_streams == off_streams  # tokens AND float-exact logprobs
+
+    def read_bytes_per_token(w):
+        tot = 0
+        for L in range(long_prompt, long_prompt + long_gen):
+            last = L // page_size
+            first = max(0, L - w + 1) // page_size if w else 0
+            tot += (last - first + 1) * page_size * bpt
+        return tot / long_gen
+
+    return {
+        "window_tokens": window,
+        "long_context_tokens": long_prompt + long_gen,
+        "short_requests": n_short,
+        "window_pool_pages": win_pool,
+        "dense_pool_pages": dense_pool,
+        "window_page_bound_per_slot": win_bound,
+        "window_peak_pages_per_long_slot": win_peak,
+        "dense_peak_pages_per_long_slot": dense_peak,
+        "window_reclaimed_pages": win_reclaimed,
+        "streams_bitwise_vs_mask_only": True,  # asserted above
+        "window_decode_read_bytes_per_token": round(
+            read_bytes_per_token(window), 1),
+        "dense_decode_read_bytes_per_token": round(
+            read_bytes_per_token(None), 1),
+        "decode_read_reduction": round(
+            read_bytes_per_token(None) / read_bytes_per_token(window),
+            2),
+        "window_ttft_p95_ms": c["serve_ttft_p95_ms"],
+        "kv_bytes_per_token": bpt,
+        "methodology": (
+            "three engines, one init: dense (full page reservation), "
+            "windowed + reclamation in a page_budget pool the dense "
+            "reservation could not serve, and windowed mask-only "
+            "(reclamation off) as the control — greedy streams and "
+            "logprobs asserted bitwise reclaim-on == mask-only in-row; "
+            "peak pages/slot sampled live from the slot frontiers "
+            "(mapped - reclaimed) while the mix drains; decode KV read "
+            "bytes/token modeled from the kernel's double-ended page "
+            "clamp (the DMA grid's exact read set) x live-pool "
+            "bytes/token, averaged over the long stream's decode "
+            "positions; on a CPU harness the engines run the XLA twin, "
+            "so byte and page columns are exact and wall-clock kernel "
+            "numbers are the TPU artifact run's"
+        ),
+    }
+
+
+def run_longcontext(model, params):
+    """bench-model `extra.serving.longcontext` row (ISSUE 19): a 12k-
+    token stream decoding through a 2k window in a pool sized well
+    under its full reach, plus short interactive traffic."""
+    return longcontext_stats(
+        model, params, window=2048, slots=4, page_size=64,
+        max_context=16384, page_budget=4 * 4096,
+        dense_page_budget=16384, vocab_size=32000,
+        long_prompt=12288, long_gen=256, short_prompt=128,
+        short_gen=64, chunk=512)
+
+
 def run_serving(n_requests=16, slots=8):
     """bench-model serving row (bf16 decode weights, decode kernel on):
     the ISSUE-3 continuous-vs-static comparison, the ISSUE-4
@@ -1340,6 +1517,7 @@ def run_serving(n_requests=16, slots=8):
     stats["prefix"] = serving_prefix_stats(model, params)
     stats["scaleout"] = serving_scaleout_stats(model, params)
     stats["disagg"] = serving_disagg_stats(model, params)
+    stats["longcontext"] = run_longcontext(model, params)
     return stats
 
 
@@ -2371,6 +2549,17 @@ def main():
             f"{serving['disagg']['decode_interference_ratio']}x vs "
             f"symmetric ({serving['disagg']['disagg']['transfer_pages']}"
             f" KV pages handed off)"
+            f"; sliding-window long-context serving (window "
+            f"{serving['longcontext']['window_tokens']} tok over a "
+            f"{serving['longcontext']['long_context_tokens']}-tok "
+            f"stream): decode KV reads "
+            f"/{serving['longcontext']['decode_read_reduction']}x, peak "
+            f"pages/long-slot "
+            f"{serving['longcontext']['dense_peak_pages_per_long_slot']}"
+            f" -> "
+            f"{serving['longcontext']['window_peak_pages_per_long_slot']}"
+            f", {serving['longcontext']['window_reclaimed_pages']} pages"
+            f" reclaimed mid-flight, streams bitwise vs mask-only"
             f"; int8 KV pages: "
             f"{quant['int8_vs_bf16_decode_tok_s']}x decode tok/s, "
             f"{quant['kv_capacity_ratio']}x tokens/HBM-byte "

@@ -252,8 +252,16 @@ def build_base_parser() -> argparse.ArgumentParser:
     g.add_argument("--use_post_ln", action="store_true", default=None)
     g.add_argument("--glu_activation", type=str, default=None)
     g.add_argument("--position_embedding_type", type=str, default=None)
-    g.add_argument("--rope_scaling_factor", type=float, default=None)
-    g.add_argument("--rope_theta", type=float, default=None)
+    g.add_argument("--rope_scaling_factor", type=float, default=None,
+                   help="linear RoPE position interpolation divisor "
+                        "(positions / factor before rotation; 1.0 = off)")
+    g.add_argument("--rope_theta", type=float, default=None,
+                   help="rotary base frequency (default 10000; long-"
+                        "context finetunes commonly raise it, e.g. 1e6)")
+    g.add_argument("--attention_window_size", type=int, default=None,
+                   help="sliding-window attention reach in tokens for "
+                        "the serving-side paged kernels (training paths "
+                        "ignore it; None = full causal)")
     g.add_argument("--parallel_attn", action="store_true", default=None)
     g.add_argument("--parallel_layernorm", action="store_true", default=None)
     g.add_argument("--no_tie_embed_logits", action="store_true")
@@ -565,7 +573,8 @@ def args_to_configs(args, padded_vocab_size: int):
         "num_attention_heads_kv", "kv_channels", "layernorm_epsilon",
         "init_method_std",
         "glu_activation", "position_embedding_type", "rope_scaling_factor",
-        "rope_theta", "hidden_dropout", "attention_dropout", "lima_dropout",
+        "rope_theta", "attention_window_size",
+        "hidden_dropout", "attention_dropout", "lima_dropout",
         "use_flash_attn", "recompute_granularity", "remat_policy",
         "recompute_method", "recompute_num_layers", "use_bias",
         "use_rms_norm", "use_post_ln", "parallel_attn", "parallel_layernorm",

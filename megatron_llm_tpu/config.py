@@ -130,6 +130,13 @@ class ModelConfig:
     # launch overhead dominates a cache this small)
     decode_attn_min_cache: int = 128
     decode_attn_interpret: bool = False
+    # Sliding-window attention on the PAGED serving path (ISSUE 19):
+    # a token at position p attends [max(0, p - W + 1), p]. None = full
+    # causal; W >= context is bitwise full-causal. Static — baked into
+    # the serving traces, and the engine reclaims pages wholly out of
+    # every live window mid-flight. Serving-side only for now: the
+    # dense training paths ignore it (GUIDE "Long-context serving").
+    attention_window_size: Optional[int] = None
 
     # BERT/T5 family (ref: --num_tokentypes language_model.py:160-170;
     # bert_binary_head bert_model.py:130)
@@ -146,6 +153,11 @@ class ModelConfig:
         if self.ffn_hidden_size is None:
             object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
         assert self.num_attention_heads % self.num_attention_heads_kv == 0
+        if self.attention_window_size is not None \
+                and self.attention_window_size < 1:
+            raise ValueError(
+                "attention_window_size must be >= 1 (or None for full "
+                f"causal attention), got {self.attention_window_size}")
         # Recompute-policy validation: unknown strings raise HERE, at config
         # construction, never downstream as a silently-wrong memory/FLOP
         # trade (the pre-policy code mapped granularity="selective" to "no

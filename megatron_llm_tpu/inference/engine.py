@@ -331,6 +331,15 @@ class _Slot:
     prefill_start: int = 0
     prefilled: int = 0
     spec_accepted: int = 0
+    # sliding-window serving (ISSUE 19): logical page frontier counters.
+    # `mapped` — logical pages [reclaimed, mapped) hold physical pages
+    # (windowed slots allocate lazily and top up just before each round
+    # writes past the frontier); `reclaimed` — logical pages [0,
+    # reclaimed) fell wholly out of every live window and were released
+    # (table entries parked on null page 0). pages[k] is the physical
+    # page at logical index reclaimed + k.
+    mapped: int = 0
+    reclaimed: int = 0
 
     @property
     def prefilling(self) -> bool:
@@ -355,7 +364,10 @@ class _Slot:
     notes="pow2-bucketed scan horizons x {greedy, mixed}; the engine "
           "passes the config-derived budget "
           "2*len(horizon_buckets(step_horizon)) at mint time; kv_dtype "
-          "is an engine-level choice, never a new variant key")
+          "is an engine-level choice, never a new variant key; so is "
+          "attention_window_size (ISSUE 19) — the window bakes into "
+          "the model config at trace time and page reclamation is host "
+          "bookkeeping, zero new executables")
 def _make_step_fn(model, vocab_size, horizon, all_greedy):
     """The jitted continuous-batching step, traced once per (engine,
     horizon bucket): a lax.scan of `horizon` single-token steps — each
@@ -442,7 +454,9 @@ def _make_step_fn(model, vocab_size, horizon, all_greedy):
     tmp_bytes_budget=4 << 20,
     notes="pow2 chunk-width buckets x {greedy, mixed}; the engine "
           "passes 2*len(mixed_width_buckets(prefill_chunk_tokens)) "
-          "at mint time")
+          "at mint time; attention_window_size is engine-static like "
+          "kv_dtype (see decode_scan) — windowed engines mint the "
+          "same width buckets, never a window-keyed variant")
 def _make_mixed_step_fn(model, vocab_size, width, all_greedy):
     """The jitted MIXED prefill+decode step (chunked admission), traced
     once per (engine, pow2 width bucket, greedy specialization): every
@@ -924,6 +938,7 @@ class DecodeEngine:
                  warmup_compile: bool = False,
                  prefix_cache: bool = False,
                  spec_decode_k: int = 0,
+                 window_reclaim: bool = True,
                  kv_dtype: str = "bf16",
                  quantize_weights: bool = False,
                  serving_tp: int = 1,
@@ -1021,6 +1036,31 @@ class DecodeEngine:
         self._prefix = PrefixCache(page_size) if prefix_cache else None
         assert spec_decode_k >= 0
         self.spec_decode_k = spec_decode_k
+        # sliding-window serving (ISSUE 19): static per-model — every
+        # serving trace of a window-enabled model bakes the O(window)
+        # kernel clamp in, and the host reclaims pages wholly out of
+        # every live window back to the free pool mid-flight (see
+        # _reclaim_window_pages). Windowed slots also ALLOCATE lazily:
+        # admission reserves only the window-bound page count and
+        # _ensure_pages tops the frontier up just before each round
+        # (see _window_slot_pages) — pool capacity prices O(window) per
+        # long slot, not O(prompt + budget).
+        w = getattr(self.cfg, "attention_window_size", None)
+        self.window = int(w) if w else None
+        # window_reclaim=False keeps the window MASK but never frees a
+        # page mid-flight — the A/B control the bitwise reclamation pin
+        # runs against (outputs must be identical by construction:
+        # reclaimed pages are exactly the ones no kernel reads again)
+        self.window_reclaim = bool(window_reclaim)
+        if self.window is not None and not prefill_chunk_tokens:
+            raise ValueError(
+                "attention_window_size requires chunked admission "
+                "(prefill_chunk_tokens > 0): whole-prompt admission "
+                "prefills through the DENSE path, which carries no "
+                "window mask, so its cache would disagree with every "
+                "windowed chunked/decode step — enable chunking or "
+                "clear the window")
+        self._window_reclaimed = 0
         self.kv_dtype = kv_dtype
         self.quantize_weights = quantize_weights
         self.termination_id = termination_id
@@ -1370,8 +1410,13 @@ class DecodeEngine:
         # must also fit the POOL: under an oversubscribed page_budget a
         # request can satisfy max_context yet need more pages than the
         # pool holds — admitted, it would sit at the FIFO head forever
-        # and starve everything behind it
+        # and starve everything behind it. Window-enabled engines
+        # (ISSUE 19) price a request at the WINDOW bound, not its full
+        # reach: out-of-window pages reclaim mid-flight, so a long slot
+        # can never hold more than _window_slot_pages at once.
         need = -(-total // self.page_size)
+        if self.window is not None and self.window_reclaim:
+            need = min(need, self._window_slot_pages())
         if need > self.num_pages - 1:
             raise ValueError(
                 f"request needs {need} pages but the pool holds only "
@@ -1503,7 +1548,23 @@ class DecodeEngine:
                     match = self._prefix.lookup(req.prompt)
                     if match.matched == 0:
                         match = None
-                need_new = need - (match.full_pages if match else 0)
+                matched_pages = match.full_pages if match else 0
+                # windowed engines (ISSUE 19) reserve only the window
+                # bound up front — _ensure_pages tops the frontier up
+                # before each round and _reclaim_window_pages returns
+                # dead pages, so a long request never holds O(prompt +
+                # budget) pages. Shared prefix pages are refcounts, not
+                # allocations, so a hit larger than the bound still
+                # maps whole (its out-of-window pages release back to
+                # the cache on the first reclaim pass); a COW divergence
+                # always gets its fresh private page.
+                cap = need
+                if self.window is not None and self.window_reclaim:
+                    cap = max(min(need, self._window_slot_pages()),
+                              matched_pages
+                              + (1 if match is not None
+                                 and match.cow_src is not None else 0))
+                need_new = max(cap - matched_pages, 0)
                 if match is not None:
                     # pin the hit (incl. the COW source) BEFORE any
                     # eviction below could free it out from under us
@@ -1532,8 +1593,10 @@ class DecodeEngine:
             fresh = [self._free_pages.pop() for _ in range(need_new)]
             pages = (list(match.pages) if match is not None else []) + fresh
             self._pt[si] = 0
-            self._pt[si, :need] = pages
+            self._pt[si, :len(pages)] = pages
             slot.pages = pages
+            slot.mapped = len(pages)
+            slot.reclaimed = 0
             slot.generated = 0
             slot.sample_step = 0
             slot.registered = match.full_pages if match is not None else 0
@@ -1675,6 +1738,8 @@ class DecodeEngine:
                     self._free_pages.append(pg)
         slot.pages = []
         slot.registered = 0
+        slot.mapped = 0
+        slot.reclaimed = 0
         self._pt[si] = 0
         self._lengths[si] = 0
         req = slot.req
@@ -1691,6 +1756,96 @@ class DecodeEngine:
                              **({"cost": cost} if cost is not None
                                 else {}))
         self._finish(req)
+
+    # -- sliding-window page bookkeeping (ISSUE 19) ------------------------
+
+    def _window_slot_pages(self) -> int:
+        """Peak physical pages a window-enabled slot holds: pages
+        overlapping [L - window + 1, L + round_width) at any length L —
+        the window itself, the widest span one round can write past it
+        (decode horizon / prefill chunk / spec verify chunk), plus one
+        boundary page each side. THE windowed capacity unit: submit()
+        prices requests with it, _admit reserves it, start() logs it."""
+        width = max(self.step_horizon, self.prefill_chunk_tokens,
+                    self.spec_decode_k + 1)
+        return min(self.max_pages_per_slot,
+                   -(-(self.window + width) // self.page_size) + 1)
+
+    def _ensure_pages(self, si: int, upto: int) -> None:
+        """Top the slot's physical page frontier up to cover positions
+        [0, upto): windowed slots allocate lazily (admission reserved
+        only the window bound), so every round calls this for exactly
+        the span it is about to write — the jitted step scatters K/V
+        across page boundaries and must find real pages in the table.
+        No-op when the frontier already covers `upto` (always, for
+        non-window engines: admission mapped the full reach)."""
+        if self.window is None:
+            return
+        want = min(-(-upto // self.page_size), self.max_pages_per_slot)
+        s = self._slots[si]
+        while s.mapped < want:
+            if not self._free_pages and self._prefix is not None:
+                self._free_pages.extend(
+                    self._prefix.evict(want - s.mapped))
+            if not self._free_pages:
+                # unreachable when submit()/_admit price the window
+                # bound correctly — reclamation returns a page for
+                # every page the frontier consumes past the window
+                raise RuntimeError(
+                    f"page pool exhausted topping slot {si} up to "
+                    f"{want} pages — window admission accounting bug")
+            pg = self._free_pages.pop()
+            self._pt[si, s.mapped] = pg
+            s.pages.append(pg)
+            s.mapped += 1
+
+    def _reclaim_window_pages(self) -> None:
+        """Release pages wholly below every live window back to the
+        pool (the engine-side half of the ISSUE 19 tentpole). At length
+        L the next query attends no position below L - window + 1, and
+        lengths are monotone, so logical pages [0, (L+1-window) //
+        page_size) are dead forever: the kernel's double-ended DMA
+        clamp never dereferences their table entries again and the XLA
+        twin masks their columns to exact-0 probabilities — freeing
+        (and reusing) them is bitwise-invisible to the stream, which
+        tests pin (reclamation ON == OFF). Refcount discipline:
+        registered/shared prefix pages RELEASE to the cache (still
+        evictable, never free-listed while referenced — a concurrent
+        slot may be reading them inside ITS window); only private
+        refcount-1 pages return to the free list. Table entries park
+        on null page 0 and slot.reclaimed advances so _retire never
+        double-releases; unregistered reclaimed pages also advance
+        slot.registered so _register_prefix can never insert a freed
+        page."""
+        W = self.window
+        if W is None or not self.window_reclaim:
+            return
+        ps = self.page_size
+        for si, s in enumerate(self._slots):
+            if s.req is None:
+                continue
+            dead = min(max(0, int(self._lengths[si]) + 1 - W) // ps,
+                       s.mapped)
+            if dead <= s.reclaimed:
+                continue
+            for p in range(s.reclaimed, dead):
+                pg = int(self._pt[si, p])
+                self._pt[si, p] = 0
+                if s.pages and s.pages[0] == pg:
+                    s.pages.pop(0)
+                if pg == 0:
+                    continue
+                if self._prefix is not None and self._prefix.release(pg):
+                    pass  # shared/registered: the cache retains it
+                else:
+                    self._free_pages.append(pg)
+                self._window_reclaimed += 1
+            n = dead - s.reclaimed
+            s.reclaimed = dead
+            if s.registered < dead:
+                s.registered = dead
+            self.tracer.instant("window_reclaim", rid=s.req.rid,
+                                slot=si, pages=n)
 
     # -- the decode loop ---------------------------------------------------
 
@@ -1828,6 +1983,10 @@ class DecodeEngine:
             # scoped (a no-op null scope on tp=1 engines)
             did = self._step_inner()
         if did:
+            # out-of-window pages died as the round advanced lengths;
+            # return them before the next round's admission/top-up
+            # prices the pool (no-op for non-window engines)
+            self._reclaim_window_pages()
             self._rounds += 1
             if self._rounds % 256 == 0:
                 self.recorder.note_counters(self.counters())
@@ -2019,6 +2178,11 @@ class DecodeEngine:
             for i in live)
         hor = min(self.step_horizon, max(remaining, 1))
         hor = 1 << (hor.bit_length() - 1)  # pow2 bucket
+        # windowed lazy allocation (ISSUE 19): the scan writes hor
+        # tokens past each live length — the frontier must hold real
+        # pages BEFORE dispatch (no-op for non-window engines)
+        for i in live:
+            self._ensure_pages(i, self._lengths[i] + hor)
 
         n = self.slots
         active = np.zeros(n, bool)
@@ -2127,6 +2291,12 @@ class DecodeEngine:
         ln = min(remaining, width)
         dec = [i for i, s in enumerate(self._slots)
                if s.req is not None and not s.prefilling]
+        # windowed lazy allocation (ISSUE 19): this round scatters the
+        # chunk's ln tokens (and one decode token per live slot) past
+        # the frontiers — top them up before dispatch
+        self._ensure_pages(ci, self._lengths[ci] + ln)
+        for i in dec:
+            self._ensure_pages(i, self._lengths[i] + 1)
 
         chunk_tokens = np.zeros((n, width), np.int32)
         chunk_lens = np.zeros((n,), np.int32)
@@ -2261,6 +2431,11 @@ class DecodeEngine:
             return []
         cap = min(self.spec_decode_k,
                   r.tokens_to_generate - s.generated - 1)
+        if self.window is not None:
+            # window edge (ISSUE 19): keep the whole verify chunk
+            # inside one window of its first position, so every chunk
+            # row still attends the round's carried context
+            cap = min(cap, self.window - 1)
         if cap <= 0:
             return []
         toks = r.tokens
@@ -2327,6 +2502,11 @@ class DecodeEngine:
         width = self.spec_decode_k + 1
         n = self.slots
         live = [i for i, s in enumerate(self._slots) if s.req is not None]
+        # windowed lazy allocation (ISSUE 19): the verify chunk writes
+        # up to 1 + len(draft) tokens past each live frontier
+        for i in live:
+            self._ensure_pages(
+                i, self._lengths[i] + 1 + len(drafts.get(i, [])))
         chunk_tokens = np.zeros((n, width), np.int32)
         chunk_lens = np.zeros((n,), np.int32)
         is_spec = np.zeros((n,), bool)
@@ -2974,6 +3154,17 @@ class DecodeEngine:
             " [fp default off: greedy parity is measured drift, not "
             "bitwise — see docs/GUIDE.md 'Quantized serving']",
         )
+        if self.window is not None:
+            # windowed capacity (ISSUE 19): what a long slot actually
+            # costs — the operator sizes page_budget against THIS bound
+            # per concurrent slot, not against max_context
+            _logger.info(
+                "sliding-window serving: window=%d tokens — peak "
+                "%d pages/slot (vs %d at full max_context reach); "
+                "out-of-window pages reclaim mid-flight "
+                "(serve_window_reclaimed_pages on /metrics)",
+                self.window, self._window_slot_pages(),
+                self.max_pages_per_slot)
         if self.warmup_compile:
             self.warmup()
         self._running = True
@@ -3187,6 +3378,13 @@ class DecodeEngine:
                 out["serve_dispatch_overhead_pct"] = round(
                     (self._measured_round_ms - self._modeled_device_ms)
                     / self._measured_round_ms * 100, 2)
+        if self.window is not None:
+            # sliding-window gauges (ISSUE 19; gated like every other
+            # feature group so the window-off JSON stays byte-
+            # compatible): the configured window and the pages returned
+            # to the pool mid-flight
+            out["serve_window_size"] = self.window
+            out["serve_window_reclaimed_pages"] = self._window_reclaimed
         if self._sentinel is not None:
             # gated like the cost gauges: the sentinel-off schema is
             # the legacy one

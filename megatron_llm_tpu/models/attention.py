@@ -322,6 +322,13 @@ def attention_block(
         # otherwise"; ONE gate means a decode row takes the SAME
         # kernel-vs-XLA path in scan and mixed steps by construction
         quantized = "k_scales" in kv_cache  # int8 pools (ISSUE 9)
+        # sliding window (ISSUE 19) rides the model config — static,
+        # so every serving trace of a window-enabled model bakes the
+        # O(window) clamp in; None leaves the trace byte-identical.
+        # "doc_starts" (packed multi-doc prefill floors) is a cache
+        # key like "chunk_lens": present only when the caller packs
+        # documents, absent from the engine's carries.
+        doc_starts = kv_cache.get("doc_starts")
         res = ragged_paged_attention(
             q, k, v, kv_cache["k_pages"], kv_cache["v_pages"],
             page_table, lengths, chunk_lens,
@@ -330,6 +337,8 @@ def attention_block(
             interpret=cfg.decode_attn_interpret,
             k_scales=kv_cache.get("k_scales"),
             v_scales=kv_cache.get("v_scales"),
+            window_size=getattr(cfg, "attention_window_size", None),
+            doc_starts=doc_starts,
         )
         # cache pytree layout is carry-stable: "chunk_lens" stays a key
         # only in the chunked form (the decode scan's carry never grows)
@@ -337,6 +346,8 @@ def attention_block(
                      "lengths": lengths + chunk_lens}
         if chunked:
             new_cache["chunk_lens"] = chunk_lens
+        if doc_starts is not None:
+            new_cache["doc_starts"] = doc_starts
         if quantized:
             (ctx, new_cache["k_pages"], new_cache["v_pages"],
              new_cache["k_scales"], new_cache["v_scales"]) = res

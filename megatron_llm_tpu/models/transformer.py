@@ -319,6 +319,9 @@ def transformer_stack(
             # branch scatters + attends the whole span at once); the
             # stack-level length advance is ragged too
             cl = kv_caches.get("chunk_lens")
+            # packed multi-doc prefill (ISSUE 19): per-chunk document
+            # floors thread through every layer exactly like chunk_lens
+            dcs = kv_caches.get("doc_starts")
             ks = list(kv_caches["k_pages_layers"])
             vs = list(kv_caches["v_pages_layers"])
             # int8 KV pools (ISSUE 9): per-layer fp32 scale pools ride
@@ -332,6 +335,8 @@ def transformer_stack(
                            "page_table": pt, "lengths": lens}
                 if cl is not None:
                     cache_l["chunk_lens"] = cl
+                if dcs is not None:
+                    cache_l["doc_starts"] = dcs
                 if kss is not None:
                     cache_l["k_scales"] = kss[i]
                     cache_l["v_scales"] = vss[i]
@@ -349,6 +354,8 @@ def transformer_stack(
             }
             if cl is not None:
                 new_caches["chunk_lens"] = cl
+            if dcs is not None:
+                new_caches["doc_starts"] = dcs
             if kss is not None:
                 new_caches["k_scales_layers"] = tuple(kss)
                 new_caches["v_scales_layers"] = tuple(vss)

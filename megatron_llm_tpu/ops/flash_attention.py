@@ -158,17 +158,34 @@ def _choose_block(size: int, requested: int, qpk: int = 1):
 
 
 def _causal_invalid(rows, block_k, qpk, pos_base, col_base,
-                    valid_rows=None):
+                    valid_rows=None, window=None, floor=None):
     """(rows, block_k) bool block, True = masked out. Folded row r (head
     fastest) is token r // qpk at causal position pos_base + r // qpk;
     column c is cache position col_base + c. With `valid_rows` (the
     ragged-chunk pad bound), rows at tokens >= valid_rows mask EVERY
-    column. pos_base / valid_rows may be traced scalars."""
+    column. pos_base / valid_rows may be traced scalars.
+
+    The two lower-bound parameterizations (ISSUE 19) are additive
+    predicates on the same block, None = off (the trace is then
+    bitwise the pre-window one):
+    - `window` (static int >= 1): sliding-window attention — a row at
+      position p attends only cols in [p - window + 1, p], so the
+      window >= context case compares against bounds that never bind
+      and stays bitwise-dense.
+    - `floor` (traced scalar): packed-doc reset — every row of the
+      block additionally masks cols < floor (the chunk's document
+      start). Callers must keep floor <= the first row's own position
+      or a valid row could mask every column (the finite-NEG_INF
+      degenerate case only pad rows are re-masked for)."""
     tok = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0) // qpk
     col = col_base + jax.lax.broadcasted_iota(
         jnp.int32, (rows, block_k), 1
     )
     invalid = col > pos_base + tok
+    if window is not None:
+        invalid = invalid | (col < pos_base + tok - (window - 1))
+    if floor is not None:
+        invalid = invalid | (col < floor)
     if valid_rows is not None:
         invalid = invalid | (tok >= valid_rows)
     return invalid

@@ -25,8 +25,10 @@ serving mesh. This module collapses the paged side to ONE kernel:
   ops/flash_attention.py (`_causal_invalid` + `_softmax_init/accum/
   finalize`): flash instantiates it for dense training, the dense
   decode kernel for standalone caches, and this kernel for the paged
-  pool — mask shapes are pluggable predicates, so sliding-window and
-  packed-doc masks later cost one predicate, not six kernels.
+  pool — mask shapes are pluggable predicates: sliding-window
+  attention (`window_size`) and packed-doc floors (`doc_starts`,
+  ISSUE 19) are predicate parameterizations of this one body riding
+  a double-ended DMA clamp, not new kernels.
 
 Kernel structure:
 
@@ -130,9 +132,10 @@ def ragged_paged_block(s: int, qpk: int, d: int, page_size: int,
 # ---------------------------------------------------------------------------
 
 
-def _paged_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
-                  *rest, block_q, page_size, qpk, d, num_pages,
-                  sm_scale, split_boundary=True, quantized=False):
+def _paged_kernel(starts_ref, lens_ref, pt_ref, *rest, block_q,
+                  page_size, qpk, d, num_pages, sm_scale,
+                  split_boundary=True, quantized=False, window=None,
+                  has_doc=False):
     """Grid (chunk, group, q_block, page); the page dim carries the
     online-softmax state. Row r of the folded (block_q*qpk, d) q block
     is chunk token i*block_q + r // qpk (head fastest) at global
@@ -140,7 +143,25 @@ def _paged_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
     `quantized` selects the int8-KV epilogue (ISSUE 9): k/v arrive int8
     with per-(token, group) fp32 scale columns as two extra
     (page_size, 1) operands, dequantized in-register before the
-    unchanged fp32 template math."""
+    unchanged fp32 template math.
+
+    Lower-bound masks (ISSUE 19) are extra parameterizations of the
+    SAME body, not new kernels — both default off, and off means the
+    emitted program is the pre-window one:
+    - `window` (static int): sliding-window attention — row at
+      position p attends cols [p - window + 1, p]. Pages wholly below
+      the q block's FIRST row's window floor drop out of `run` (and
+      the index map clamps them to the first needed page, eliding the
+      DMA), pages below the LAST row's floor leave `interior`, so the
+      window boundary pays the mask exactly like the causal boundary.
+    - `has_doc`: a fourth scalar-prefetch operand doc_starts (nc,)
+      gives each chunk an attention FLOOR (its packed document's first
+      position); cols below it mask out, resetting causality at doc
+      boundaries. Requires doc_starts[c] <= starts[c] so every valid
+      row keeps its own diagonal column."""
+    if has_doc:
+        doc_ref, *rest = rest
+    q_ref, k_ref, v_ref, *rest = rest
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -151,6 +172,7 @@ def _paged_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
     rows = block_q * qpk
     start = starts_ref[c]
     clen = lens_ref[c]
+    doc0 = doc_ref[c] if has_doc else None
 
     @pl.when(j == 0)
     def _init():
@@ -182,7 +204,8 @@ def _paged_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
             sc = jnp.where(
                 _causal_invalid(rows, page_size, qpk,
                                 start + i * block_q, j * page_size,
-                                valid_rows=clen - i * block_q),
+                                valid_rows=clen - i * block_q,
+                                window=window, floor=doc0),
                 NEG_INF, sc,
             )
         if quantized:
@@ -198,11 +221,29 @@ def _paged_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
     blk_last_tok = jnp.minimum((i + 1) * block_q, clen) - 1
     run = (i * block_q < clen) & \
         ((j * page_size) <= (start + blk_last_tok))
+    if window is not None or has_doc:
+        # symmetric lower skip: pages wholly below even the FIRST
+        # row's floor serve no row of this q block. For window >=
+        # context the floor is never positive and the predicate (like
+        # the clamp) never binds — bitwise the dense program.
+        first_lo = jnp.int32(0)
+        if window is not None:
+            first_lo = jnp.maximum(first_lo,
+                                   start + i * block_q - (window - 1))
+        if has_doc:
+            first_lo = jnp.maximum(first_lo, doc0)
+        run = run & ((j * page_size + page_size - 1) >= first_lo)
     if split_boundary:
         # maskless when every row is valid AND every column is causal
         # for even the block's FIRST token
         interior = ((i + 1) * block_q <= clen) & \
             ((j * page_size + page_size - 1) <= (start + i * block_q))
+        if window is not None:
+            # ... AND in-window for even the LAST token's floor
+            interior = interior & \
+                ((j * page_size) >= (start + (i + 1) * block_q - window))
+        if has_doc:
+            interior = interior & ((j * page_size) >= doc0)
 
         @pl.when(run & interior)
         def _compute_interior():
@@ -228,18 +269,25 @@ def _paged_kernel(starts_ref, lens_ref, pt_ref, q_ref, k_ref, v_ref,
 
 
 def _paged_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
-                  block_q, interpret, k_scales=None, v_scales=None):
+                  block_q, interpret, k_scales=None, v_scales=None,
+                  window=None, doc_starts=None):
     """q: (nc, C, g, qpk, d); k/v_pages: (P, page_size, g, d);
     page_table: (nc, max_pages) int32; starts/chunk_lens: (nc,) int32.
     k/v_scales (int8 pools only): (P, page_size, g) fp32 per-(token,
-    group) scales riding the same clamped page index map. Returns
-    (nc, C, g, qpk, d) in q's dtype (pad rows exact zero)."""
+    group) scales riding the same clamped page index map. `window`
+    (static) / `doc_starts` ((nc,) int32, a 4th scalar-prefetch
+    operand) add the ISSUE 19 lower bounds: the page index map then
+    clamps BOTH ends, so out-of-window / pre-document pages repeat an
+    in-bound index and Mosaic elides their DMAs — decode-row traffic
+    is O(window), not O(context). Returns (nc, C, g, qpk, d) in q's
+    dtype (pad rows exact zero)."""
     nc, C, g, qpk, d = q.shape
     page_size = k_pages.shape[1]
     max_pages = page_table.shape[1]
     rows = block_q * qpk
     num_q_blocks = C // block_q
     quantized = k_scales is not None
+    has_doc = doc_starts is not None
 
     qf = q.transpose(0, 2, 1, 3, 4).reshape(nc, g, C * qpk, d)
     # rows below one fp32 sublane tile: launch q/o in fp32 (the small-
@@ -251,9 +299,10 @@ def _paged_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
         _paged_kernel, block_q=block_q, page_size=page_size, qpk=qpk,
         d=d, num_pages=max_pages, sm_scale=1.0 / (d ** 0.5),
         split_boundary=not interpret, quantized=quantized,
+        window=window, has_doc=has_doc,
     )
 
-    def page_index(c, i, j, starts_ref, lens_ref, pt_ref):
+    def page_index(c, i, j, starts_ref, lens_ref, pt_ref, doc_ref=None):
         # clamp past-the-need page indices to the LAST page this q block
         # attends (repeated index -> elided DMA): traffic follows
         # start + len, not the allocated table width. All-pad blocks and
@@ -263,16 +312,31 @@ def _paged_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
                                jnp.maximum(lens_ref[c], 1)) - 1
         last = jnp.clip((starts_ref[c] + last_tok) // page_size,
                         0, max_pages - 1)
-        return pt_ref[c, jnp.minimum(j, last)]
+        if window is None and doc_ref is None:
+            return pt_ref[c, jnp.minimum(j, last)]
+        # symmetric LOWER clamp (ISSUE 19): pages wholly before the q
+        # block's first row's window floor / the chunk's document
+        # start repeat the first needed page — same elision, so the
+        # engine may reclaim the pages behind it (the kernel can never
+        # dereference a table entry below `first` by construction).
+        # window >= context keeps the floor at 0 == bitwise-dense.
+        lo = jnp.int32(0)
+        if window is not None:
+            lo = jnp.maximum(
+                lo, starts_ref[c] + i * block_q - (window - 1))
+        if doc_ref is not None:
+            lo = jnp.maximum(lo, doc_ref[c])
+        first = jnp.clip(lo // page_size, 0, max_pages - 1)
+        return pt_ref[c, jnp.clip(j, first, last)]
 
     q_spec = pl.BlockSpec(
         (None, None, rows, d),
-        lambda c, gi, i, j, s_ref, l_ref, pt_ref: (c, gi, i, 0),
+        lambda c, gi, i, j, *s_refs: (c, gi, i, 0),
     )
     kv_spec = pl.BlockSpec(
         (None, page_size, None, d),
-        lambda c, gi, i, j, s_ref, l_ref, pt_ref: (
-            page_index(c, i, j, s_ref, l_ref, pt_ref), 0, gi, 0
+        lambda c, gi, i, j, *s_refs: (
+            page_index(c, i, j, *s_refs), 0, gi, 0
         ),
     )
     in_specs = [q_spec, kv_spec, kv_spec]
@@ -280,14 +344,19 @@ def _paged_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
     if quantized:
         scale_spec = pl.BlockSpec(
             (None, page_size, 1),
-            lambda c, gi, i, j, s_ref, l_ref, pt_ref: (
-                page_index(c, i, j, s_ref, l_ref, pt_ref), 0, gi
+            lambda c, gi, i, j, *s_refs: (
+                page_index(c, i, j, *s_refs), 0, gi
             ),
         )
         in_specs += [scale_spec, scale_spec]
         operands += [k_scales, v_scales]
+    scalars = [jnp.asarray(starts, jnp.int32),
+               jnp.asarray(chunk_lens, jnp.int32),
+               jnp.asarray(page_table, jnp.int32)]
+    if has_doc:
+        scalars.append(jnp.asarray(doc_starts, jnp.int32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=len(scalars),
         grid=(nc, g, num_q_blocks, max_pages),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -309,8 +378,7 @@ def _paged_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
                                  "arbitrary"),
         ),
         interpret=interpret,
-    )(jnp.asarray(starts, jnp.int32), jnp.asarray(chunk_lens, jnp.int32),
-      jnp.asarray(page_table, jnp.int32), *operands)
+    )(*scalars, *operands)
     return out.reshape(nc, g, C, qpk, d).transpose(0, 2, 1, 3, 4) \
         .astype(q.dtype)
 
@@ -322,7 +390,7 @@ def _paged_pallas(q, k_pages, v_pages, page_table, starts, chunk_lens,
 # ---------------------------------------------------------------------------
 
 
-def _xla_attend(q, k, v, row_pos, row_valid=None):
+def _xla_attend(q, k, v, row_pos, row_valid=None, row_lo=None):
     """The dense masked-softmax core every XLA attention twin shares:
     q (b, s, g, qpk, d) against dense k/v (b, g, T, d). `row_pos` is the
     last attendable cache position per folded row — (rows,) when shared
@@ -330,8 +398,11 @@ def _xla_attend(q, k, v, row_pos, row_valid=None):
     sequence (the paged twin). `row_valid` (b, rows), optional: rows
     where False pin to exact zero (the pad-row / empty-chunk contract);
     None skips the select entirely so the dense twin's HLO is
-    unchanged. Masked columns multiply unwritten cache by an exact fp 0,
-    so the allocated width never leaks into values."""
+    unchanged. `row_lo` (b, rows), optional: the FIRST attendable cache
+    position per folded row (the sliding-window / packed-doc lower
+    bound, ISSUE 19) — None skips that select the same way. Masked
+    columns multiply unwritten (or reclaimed-and-reused) cache by an
+    exact fp 0, so the allocated width never leaks into values."""
     b, s, g, qpk, d = q.shape
     T = k.shape[2]
     qb = q.transpose(0, 2, 1, 3, 4).reshape(b, g, s * qpk, d)
@@ -347,6 +418,10 @@ def _xla_attend(q, k, v, row_pos, row_valid=None):
         mask = jnp.arange(T)[None, None, :] > row_pos[:, :, None]
         scores = jnp.where(mask[:, None], jnp.finfo(jnp.float32).min,
                            scores)
+    if row_lo is not None:
+        lo_mask = jnp.arange(T)[None, None, :] < row_lo[:, :, None]
+        scores = jnp.where(lo_mask[:, None], jnp.finfo(jnp.float32).min,
+                           scores)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jax.lax.dot_general(
         probs, v, (((3,), (2,)), ((0, 1), (0, 1))),
@@ -358,7 +433,8 @@ def _xla_attend(q, k, v, row_pos, row_valid=None):
 
 
 def _xla_paged_reference(q, k_pages, v_pages, page_table, starts,
-                         chunk_lens, k_scales=None, v_scales=None):
+                         chunk_lens, k_scales=None, v_scales=None,
+                         window=None, doc_starts=None):
     """Gather each chunk's pages into the dense view, then the
     `_xla_attend` core with ragged per-chunk row positions — the
     shapes-and-math twin of the kernel, the off-TPU serving path, and
@@ -366,7 +442,12 @@ def _xla_paged_reference(q, k_pages, v_pages, page_table, starts,
     int8 pools pass their scale pools and dequantize to the fp32 view
     first (the quantize-then-dequantize oracle — the same fp32 values
     the kernel's in-register epilogue feeds the same math). Pad rows
-    (token >= chunk_lens) pin to the kernel's exact-zero output."""
+    (token >= chunk_lens) pin to the kernel's exact-zero output.
+    `window` / `doc_starts` (ISSUE 19) become a per-row lower bound
+    row_lo = max(pos - window + 1, doc_starts[c], 0): this path
+    GATHERS every table entry (reclaimed entries park on null page 0),
+    but the lower mask multiplies those columns by an exact fp 0, so
+    mid-flight page reclamation is bitwise-invisible here too."""
     nc, C, g, qpk, d = q.shape
     if k_scales is not None:
         k_pages = k_pages.astype(jnp.float32) * k_scales[..., None]
@@ -379,7 +460,15 @@ def _xla_paged_reference(q, k_pages, v_pages, page_table, starts,
     tok = jnp.arange(C * qpk) // qpk  # (rows,)
     row_pos = starts[:, None] + tok[None, :]  # (nc, rows)
     row_valid = tok[None, :] < chunk_lens[:, None]  # (nc, rows)
-    return _xla_attend(q, k, v, row_pos, row_valid=row_valid)
+    row_lo = None
+    if window is not None or doc_starts is not None:
+        row_lo = jnp.zeros_like(row_pos)
+        if window is not None:
+            row_lo = jnp.maximum(row_lo, row_pos - (window - 1))
+        if doc_starts is not None:
+            row_lo = jnp.maximum(row_lo, doc_starts[:, None])
+    return _xla_attend(q, k, v, row_pos, row_valid=row_valid,
+                       row_lo=row_lo)
 
 
 def scatter_chunk_kv(k_new, v_new, k_pages, v_pages, page_table, starts,
@@ -441,6 +530,8 @@ def ragged_paged_attention(
     interpret: bool = False,
     k_scales: Optional[jnp.ndarray] = None,  # (num_pages, page_size, g)
     v_scales: Optional[jnp.ndarray] = None,  # fp32; required for int8
+    window_size: Optional[int] = None,  # static; None/<=0 = full causal
+    doc_starts: Optional[jnp.ndarray] = None,  # (nc,) int32 doc floors
 ):
     """THE paged attention entry point, one pass for every phase:
     scatter the chunk's own K/V into its slot's pages, then causal
@@ -458,8 +549,22 @@ def ragged_paged_attention(
     pools too — the scatter quantizes the chunk's fp K/V at write time,
     attention dequantizes in-register (kernel) or on the gathered view
     (XLA twin), and the return grows to (out, k_pages, v_pages,
-    k_scales, v_scales)."""
+    k_scales, v_scales).
+
+    Window is a parameter too (ISSUE 19): `window_size` W restricts
+    token t to cache positions [max(0, starts + t - W + 1), starts + t]
+    in BOTH paths — the kernel's double-ended DMA clamp makes the read
+    O(W), the twin masks the same columns to exact-0 probabilities, and
+    W >= starts + chunk_lens (window covers the context) is bitwise the
+    W=None program, so the engine may reclaim pages wholly below every
+    live window. `doc_starts` (per-chunk floors, doc_starts[c] <=
+    starts[c]) packs multiple documents into one ragged launch with
+    zero cross-doc attention: give each document its own chunk over the
+    same slot pages and its own start, floored at its first position.
+    Both default to None == the pre-ISSUE-19 trace, byte-identical."""
     nc, C, g, qpk, d = q.shape
+    if window_size is not None and window_size <= 0:
+        window_size = None
     quantized = k_pages.dtype == jnp.int8
     if quantized:
         k_pages, v_pages, k_scales, v_scales = scatter_chunk_kv(
@@ -480,13 +585,16 @@ def ragged_paged_attention(
         if bq is not None:
             out = _paged_pallas(q, k_pages, v_pages, page_table,
                                 starts, chunk_lens, bq, interpret,
-                                k_scales=k_scales, v_scales=v_scales)
+                                k_scales=k_scales, v_scales=v_scales,
+                                window=window_size,
+                                doc_starts=doc_starts)
             if quantized:
                 return out, k_pages, v_pages, k_scales, v_scales
             return out, k_pages, v_pages
     out = _xla_paged_reference(q, k_pages, v_pages, page_table, starts,
                                chunk_lens, k_scales=k_scales,
-                               v_scales=v_scales)
+                               v_scales=v_scales, window=window_size,
+                               doc_starts=doc_starts)
     if quantized:
         return out, k_pages, v_pages, k_scales, v_scales
     return out, k_pages, v_pages

@@ -113,22 +113,31 @@ def _case(nc, C, g, qpk, d, ps, mp, kv="fp32", seed=0):
         None, None
 
 
-def _both(q, kn, vn, kp, vp, pt, starts, lens, ks=None, vs=None):
+def _both(q, kn, vn, kp, vp, pt, starts, lens, ks=None, vs=None,
+          window=None, doc_starts=None):
     """Kernel (interpret policy) + the oracle on the post-scatter
     pools; returns (kernel out, oracle out, kernel pools, scatter-only
     pools)."""
     starts = jnp.asarray(starts, jnp.int32)
     lens = jnp.asarray(lens, jnp.int32)
+    if doc_starts is not None:
+        doc_starts = jnp.asarray(doc_starts, jnp.int32)
     res = ragged_paged_attention(q, kn, vn, kp, vp, pt, starts, lens,
                                  use_pallas=True, interpret=INTERPRET,
-                                 k_scales=ks, v_scales=vs)
+                                 k_scales=ks, v_scales=vs,
+                                 window_size=window,
+                                 doc_starts=doc_starts)
     sc = scatter_chunk_kv(kn, vn, kp, vp, pt, starts, lens,
                           k_scales=ks, v_scales=vs)
     if ks is not None:
         out_x = _xla_paged_reference(q, sc[0], sc[1], pt, starts, lens,
-                                     k_scales=sc[2], v_scales=sc[3])
+                                     k_scales=sc[2], v_scales=sc[3],
+                                     window=window,
+                                     doc_starts=doc_starts)
     else:
-        out_x = _xla_paged_reference(q, sc[0], sc[1], pt, starts, lens)
+        out_x = _xla_paged_reference(q, sc[0], sc[1], pt, starts, lens,
+                                     window=window,
+                                     doc_starts=doc_starts)
     return res[0], out_x, res[1:], sc
 
 
@@ -219,6 +228,172 @@ class TestUnifiedKernelSweep:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+class TestWindowedAndPackedDocs:
+    """ISSUE 19: `window_size` / `doc_starts` on the SAME kernel — the
+    lower bounds ride the existing interior/boundary mask split and the
+    double-ended DMA clamp, so the sweep below is the same phase x kv
+    matrix with the window axis added, against the same one oracle."""
+
+    @pytest.mark.parametrize("kv", ["bf16", "int8"])
+    @pytest.mark.parametrize("phase", list(PHASES))
+    def test_window_axis_off_covering_binding(self, phase, kv):
+        """The three window regimes of one cell: W=None (the base
+        trace), W >= context (must be BITWISE the base on both paths —
+        the reclamation soundness anchor), and W < context (the mask
+        binds: output changes, and kernel still matches the oracle
+        under the same window)."""
+        _, _, ps, tol = KV_DTYPES[kv]
+        C, starts_fn, lens = PHASES[phase]
+        q, kn, vn, kp, vp, pt, ks, vs = _case(3, C, 2, 2, 128, ps, 2,
+                                              kv=kv, seed=13)
+        starts = starts_fn(ps)
+        base_k, base_x, _, _ = _both(q, kn, vn, kp, vp, pt, starts,
+                                     lens, ks, vs)
+        # W >= any start + len the pool can reach: bitwise the W=None
+        # program — the lower bound never binds, the trace is identical
+        ge_k, ge_x, _, _ = _both(q, kn, vn, kp, vp, pt, starts, lens,
+                                 ks, vs, window=4 * ps)
+        np.testing.assert_array_equal(np.asarray(ge_k),
+                                      np.asarray(base_k))
+        np.testing.assert_array_equal(np.asarray(ge_x),
+                                      np.asarray(base_x))
+        # W < context: kernel vs oracle under the same window, and the
+        # mask actually bound somewhere (else this cell proves nothing)
+        win_k, win_x, _, _ = _both(q, kn, vn, kp, vp, pt, starts, lens,
+                                   ks, vs, window=ps)
+        np.testing.assert_allclose(
+            np.asarray(win_k, np.float32), np.asarray(win_x, np.float32),
+            rtol=tol, atol=tol, err_msg=f"{phase}/{kv}/window={ps}")
+        assert np.any(np.asarray(win_k, np.float32)
+                      != np.asarray(base_k, np.float32)), \
+            f"{phase}/{kv}: window={ps} never bound"
+
+    @pytest.mark.parametrize("kv", ["bf16", "int8"])
+    def test_tp2_windowed_bitwise(self, kv):
+        """Window under the tp2 GSPMD mesh: groups stay independent —
+        the sharded windowed run is BITWISE the single-device windowed
+        run, and W >= context stays bitwise the dense mesh run."""
+        from megatron_llm_tpu.parallel.mesh import MODEL_AXIS
+        from megatron_llm_tpu.parallel.sharding import kv_pool_spec
+
+        _, _, ps, _ = KV_DTYPES[kv]
+        C, starts_fn, lens = PHASES["partial-page"]
+        q, kn, vn, kp, vp, pt, ks, vs = _case(3, C, 2, 2, 128, ps, 2,
+                                              kv=kv, seed=17)
+        starts = jnp.asarray(starts_fn(ps), jnp.int32)
+        lens = jnp.asarray(lens, jnp.int32)
+
+        def op(window):
+            def f(q, kn, vn, kp, vp, pt, starts, lens, ks, vs):
+                return ragged_paged_attention(
+                    q, kn, vn, kp, vp, pt, starts, lens,
+                    use_pallas=False, k_scales=ks, v_scales=vs,
+                    window_size=window)
+            return f
+
+        dense1 = jax.jit(op(None))(q, kn, vn, kp, vp, pt, starts, lens,
+                                   ks, vs)
+        win1 = jax.jit(op(ps))(q, kn, vn, kp, vp, pt, starts, lens,
+                               ks, vs)
+        mesh = Mesh(np.array(jax.devices()[:2]), (MODEL_AXIS,))
+
+        def put(x, spec):
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        args = (put(q, P(None, None, MODEL_AXIS, None, None)),
+                put(kn, P(None, None, MODEL_AXIS, None)),
+                put(vn, P(None, None, MODEL_AXIS, None)),
+                put(kp, kv_pool_spec(kp.shape, 2)),
+                put(vp, kv_pool_spec(vp.shape, 2)),
+                put(pt, P()), put(starts, P()), put(lens, P()),
+                put(ks, kv_pool_spec(ks.shape, 2)) if ks is not None
+                else None,
+                put(vs, kv_pool_spec(vs.shape, 2)) if vs is not None
+                else None)
+        for a, b in zip(jax.jit(op(ps))(*args), win1):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.jit(op(4 * ps))(*args), dense1):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_out_of_window_pages_inert_and_reclaimable(self):
+        """The engine's reclamation contract, pinned at the op: pages
+        wholly below every live row's window floor may be (a) filled
+        with garbage by a reuse and (b) zeroed out of the page table
+        (the reclaimed-to-null state) without perturbing one output
+        bit on EITHER path — the kernel's double-ended clamp never
+        DMAs them, the oracle multiplies them by an exact fp 0."""
+        ps, mp = 16, 4
+        q, kn, vn, kp, vp, pt, _, _ = _case(2, 1, 2, 2, 128, ps, mp,
+                                            seed=19)
+        starts = jnp.asarray([40, 55], jnp.int32)
+        lens = jnp.asarray([1, 1], jnp.int32)
+        W = ps
+        base_k, base_x, _, _ = _both(q, kn, vn, kp, vp, pt, starts,
+                                     lens, window=W)
+        # pages wholly before min row floor start - W + 1 are dead
+        ptn = np.asarray(pt)
+        kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+        pt2 = ptn.copy()
+        dead = 0
+        for c, s in enumerate([40, 55]):
+            lo = s - W + 1
+            for j in range(mp):
+                if (j + 1) * ps <= lo:
+                    kp2[ptn[c, j]] = 1e30  # reused by another slot
+                    vp2[ptn[c, j]] = -1e30
+                    pt2[c, j] = 0  # reclaimed: table entry nulled
+                    dead += 1
+        assert dead >= 3  # chunk 0 drops 1 page, chunk 1 drops 2
+        got_k, got_x, _, _ = _both(q, kn, vn, jnp.asarray(kp2),
+                                   jnp.asarray(vp2), jnp.asarray(pt2),
+                                   starts, lens, window=W)
+        np.testing.assert_array_equal(np.asarray(got_k),
+                                      np.asarray(base_k))
+        np.testing.assert_array_equal(np.asarray(got_x),
+                                      np.asarray(base_x))
+
+    def test_packed_docs_attend_within_doc_only(self):
+        """Packed multi-doc prefill: two documents as two chunks over
+        the SAME slot pages, each floored at its own start — zero
+        cross-document attention, so each chunk equals dense causal
+        attention over its own document alone, on both paths."""
+        from megatron_llm_tpu.models.attention import (
+            causal_mask,
+            grouped_attention,
+        )
+
+        g, qpk, d, ps, C = 2, 2, 128, 16, 8
+        q, kn, vn, kp, vp, pt, _, _ = _case(2, C, g, qpk, d, ps, 2,
+                                            seed=23)
+        pt = jnp.tile(pt[:1], (2, 1))  # both docs share slot 0's pages
+        starts, lens = [0, C], [C, C]
+        doc = [0, C]
+        out_k, out_x, _, _ = _both(q, kn, vn, kp, vp, pt, starts, lens,
+                                   doc_starts=doc)
+
+        class _Cfg:
+            attention_dropout = 0.0
+            num_query_groups, q_per_kv, head_dim = g, qpk, d
+
+        for c in range(2):
+            ref = grouped_attention(q[c:c + 1], kn[c:c + 1],
+                                    vn[c:c + 1], causal_mask(C), _Cfg(),
+                                    None, True)
+            for out in (out_k, out_x):
+                np.testing.assert_allclose(
+                    np.asarray(out[c]).reshape(1, C, -1),
+                    np.asarray(ref), rtol=1e-5, atol=1e-5,
+                    err_msg=f"doc {c}")
+        # the floor BOUND: without doc_starts, doc 1 sees doc 0's keys
+        nof_k, _, _, _ = _both(q, kn, vn, kp, vp, pt, starts, lens)
+        assert np.any(np.asarray(out_k[1]) != np.asarray(nof_k[1]))
+        # degenerate floor == start is the plain causal program
+        zf_k, zf_x, _, _ = _both(q, kn, vn, kp, vp, pt, starts, lens,
+                                 doc_starts=[0, 0])
+        np.testing.assert_array_equal(np.asarray(zf_k),
+                                      np.asarray(nof_k))
+
+
 class TestHistoricalPins:
     def test_width_one_chunk_is_the_decode_path(self):
         """The former test suites pinned a width-1 chunk bitwise-equal
@@ -257,6 +432,29 @@ class TestHistoricalPins:
         np.testing.assert_allclose(
             np.asarray(out8[:, 0]), np.asarray(out[:, 0]),
             rtol=1e-6, atol=1e-6)
+
+    def test_window_boundary_exact_cover_is_dense(self):
+        """The reclamation bound at its tightest: a decode row at
+        position p with W == p + 1 has lower bound exactly 0 — still
+        bitwise the dense program; W == p drops exactly position 0 and
+        must change the output. Off-by-one here silently breaks either
+        the fast path (too wide) or correctness (too narrow)."""
+        slots = 2
+        q, kn, vn, kp, vp, pt, _, _ = _case(slots, 1, 2, 2, 128, 16, 4,
+                                            seed=29)
+        lengths = jnp.asarray([7, 33], jnp.int32)
+        ones = jnp.ones_like(lengths)
+        args = (q, kn, vn, kp, vp, pt, lengths, ones)
+        kw = dict(use_pallas=True, interpret=INTERPRET)
+        base = ragged_paged_attention(*args, **kw)[0]
+        cover = ragged_paged_attention(*args, window_size=34, **kw)[0]
+        np.testing.assert_array_equal(np.asarray(cover),
+                                      np.asarray(base))
+        clipped = ragged_paged_attention(*args, window_size=33, **kw)[0]
+        assert np.any(np.asarray(clipped[1]) != np.asarray(base[1]))
+        # slot 0 (position 7 < W) is untouched by the clip
+        np.testing.assert_array_equal(np.asarray(clipped[0]),
+                                      np.asarray(base[0]))
 
     def test_empty_and_pad_chunks_are_exact_zero(self):
         """Length-0 chunks (idle slots of a mixed step) and the pad

@@ -36,6 +36,16 @@ Three passes over the real package, one exit code:
   costs pass reuses the audit report already computed — one lowering
   pass feeds both gates.
 
+- `verdict` (ROADMAP 5c) runs all three gates and folds them — plus
+  the bench headline diff when artifact JSONs are supplied via
+  `--bench-artifact` (this run) and `--bench-baseline` (the pinned
+  prior run) — into ONE machine-readable go/no-go object: every gate
+  named, every failure a reason string, `"verdict": "GO" | "NO-GO"`.
+  The per-PR regression gate: what BENCH_r05-era discipline did by
+  hand, as machinery. A bench artifact without a baseline is recorded
+  informationally (headline echoed, gate not armed); a headline
+  tok/s drop past BENCH_HEADLINE_MAX_DROP vs the baseline is NO-GO.
+
 Runs anywhere in < 90 s with JAX_PLATFORMS=cpu (the audit sets it
 itself). Exit codes: 0 clean, 1 findings/violations, 2 usage.
 """
@@ -62,6 +72,12 @@ COST_BASELINE = os.path.join(
 # compiler fusion choices, so the bar is looser.
 COST_FLOPS_MAX_RATIO = 1.25
 COST_TEMP_MAX_RATIO = 1.5
+
+# verdict's bench-headline gate: the artifact's headline value (tok/s/
+# chip) may drop at most this fraction vs the pinned baseline artifact
+# before the verdict flips to NO-GO. Wall-clock numbers are noisier
+# than compiled costs, so the bar is a ratio, not an equality.
+BENCH_HEADLINE_MAX_DROP = 0.05
 
 
 def run_lint(list_keys: bool = False) -> dict:
@@ -271,12 +287,112 @@ def run_costs(audit_report=None, baseline_path: str = COST_BASELINE,
     }
 
 
+def _bench_diff(artifact_path, baseline_path):
+    """The bench half of the verdict: echo this run's headline, and
+    when a pinned baseline artifact rides along, gate the headline
+    value (tok/s/chip) against BENCH_HEADLINE_MAX_DROP. Returns None
+    when no artifact was supplied (the gate simply isn't armed —
+    compile-cost diffs already cover every jitted entry point)."""
+    if not artifact_path:
+        return None
+    with open(artifact_path, "r", encoding="utf-8") as fh:
+        art = json.load(fh)
+    out = {
+        "headline_value": art.get("value"),
+        "unit": art.get("unit"),
+        "vs_paper_baseline": art.get("vs_baseline"),
+        "artifact": artifact_path,
+        "max_drop": BENCH_HEADLINE_MAX_DROP,
+    }
+    if not baseline_path:
+        out |= {"ok": None,
+                "note": "no --bench-baseline: headline recorded, "
+                        "gate not armed"}
+        return out
+    with open(baseline_path, "r", encoding="utf-8") as fh:
+        base = json.load(fh)
+    now, then = art.get("value"), base.get("value")
+    if not isinstance(now, (int, float)) \
+            or not isinstance(then, (int, float)) or then <= 0:
+        out |= {"ok": False,
+                "note": f"unreadable headline values "
+                        f"(now={now!r}, baseline={then!r})"}
+        return out
+    ratio = now / then
+    out |= {
+        "baseline_value": then,
+        "baseline_artifact": baseline_path,
+        "headline_ratio": round(ratio, 4),
+        "ok": ratio >= 1.0 - BENCH_HEADLINE_MAX_DROP,
+    }
+    return out
+
+
+def build_verdict(report, bench=None) -> dict:
+    """Fold the gate sections (and the optional bench diff) into the
+    ONE go/no-go object (ROADMAP 5c): every gate named with its
+    boolean, every failure compressed to a reason string a human (or
+    the next automation layer) can act on without re-running the
+    passes. Pure function over already-computed reports — tested
+    directly, no lowering pass needed."""
+    gates, reasons = {}, []
+    lint = report.get("lint")
+    if lint is not None:
+        gates["lint"] = bool(lint["ok"])
+        if lint["new"]:
+            reasons.append(f"lint: {len(lint['new'])} new finding(s) "
+                           f"vs baseline")
+        if lint.get("stale_baseline_keys"):
+            reasons.append(f"lint: {len(lint['stale_baseline_keys'])} "
+                           f"stale baseline key(s)")
+    audit = report.get("audit")
+    if audit is not None:
+        gates["audit"] = bool(audit["ok"])
+        bad = [t for t in audit.get("targets", []) if not t["ok"]]
+        if bad:
+            reasons.append(
+                "audit: contract failure(s) in "
+                + ", ".join(f"{t['contract']}[{t['mesh']}]"
+                            for t in bad[:5]))
+        if audit.get("marker_problems"):
+            reasons.append(f"audit: {len(audit['marker_problems'])} "
+                           f"marker problem(s)")
+    costs = report.get("costs")
+    if costs is not None:
+        gates["costs"] = bool(costs["ok"])
+        for field in ("regressions", "missing_keys", "stale_keys"):
+            if costs.get(field):
+                reasons.append(
+                    f"costs: {len(costs[field])} {field} "
+                    f"(first: {costs[field][0]})"[:200])
+    if bench is not None:
+        # ok=None (artifact without baseline) is informational, not a
+        # gate — only an ARMED bench diff can veto
+        if bench.get("ok") is not None:
+            gates["bench_headline"] = bool(bench["ok"])
+            if not bench["ok"]:
+                reasons.append(
+                    f"bench: headline {bench.get('headline_value')} vs "
+                    f"baseline {bench.get('baseline_value')} "
+                    f"(ratio {bench.get('headline_ratio')}, floor "
+                    f"{1.0 - BENCH_HEADLINE_MAX_DROP})")
+    ok = all(gates.values())
+    return {
+        "verdict": "GO" if ok else "NO-GO",
+        "ok": ok,
+        "gates": gates,
+        "reasons": reasons,
+        "bench": bench,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graft_check",
         description="JAX trace-discipline lint + AOT compile-contract "
                     "audit gate")
-    ap.add_argument("command", choices=("lint", "audit", "costs", "all"))
+    ap.add_argument("command",
+                    choices=("lint", "audit", "costs", "all", "verdict"))
     ap.add_argument("--json", metavar="PATH",
                     help="write the full machine-readable report here")
     ap.add_argument("--list-keys", action="store_true",
@@ -291,23 +407,43 @@ def main(argv=None) -> int:
     ap.add_argument("--justify", default="",
                     help="justification stamped on updated cost-"
                          "baseline entries")
+    ap.add_argument("--bench-artifact", metavar="PATH", default=None,
+                    help="verdict only: this run's bench JSON "
+                         "(bench.py output) — headline echoed into "
+                         "the verdict")
+    ap.add_argument("--bench-baseline", metavar="PATH", default=None,
+                    help="verdict only: the pinned prior bench JSON — "
+                         "arms the headline-regression gate")
     args = ap.parse_args(argv)
 
     report = {}
     audit_report = None
-    if args.command in ("lint", "all"):
+    if args.command in ("lint", "all", "verdict"):
         report["lint"] = run_lint(list_keys=args.list_keys)
-    if args.command in ("audit", "costs", "all"):
-        # ONE lowering pass feeds both the audit and the cost diff
+    if args.command in ("audit", "costs", "all", "verdict"):
+        # ONE lowering pass feeds the audit, the cost diff AND verdict
         audit_report = run_audit()
-    if args.command in ("audit", "all"):
+    if args.command in ("audit", "all", "verdict"):
         report["audit"] = audit_report
-    if args.command in ("costs", "all"):
+    if args.command in ("costs", "all", "verdict"):
         report["costs"] = run_costs(
             audit_report, baseline_path=args.cost_baseline,
             update=args.update_costs, justify=args.justify)
 
-    ok = all(section["ok"] for section in report.values())
+    if args.command == "verdict":
+        verdict = build_verdict(
+            report, bench=_bench_diff(args.bench_artifact,
+                                      args.bench_baseline))
+        report["verdict"] = verdict
+        ok = verdict["ok"]
+        for r in verdict["reasons"]:
+            print(f"VERDICT REASON: {r}")
+        print(f"verdict: gates "
+              + " ".join(f"{k}={'OK' if v else 'FAIL'}"
+                         for k, v in verdict["gates"].items())
+              + f" -> {verdict['verdict']}")
+    else:
+        ok = all(section["ok"] for section in report.values())
     report["ok"] = ok
     if args.json:
         with open(args.json, "w", encoding="utf-8") as fh:

@@ -186,6 +186,32 @@ def main():
                         "page export/import pair, short prompts go "
                         "direct. Requires 0 < N < router_replicas; "
                         "0 = symmetric fleet (the default)")
+    # ISSUE 19 long-context serving (docs/GUIDE.md "Long-context
+    # serving"): RoPE reach knobs + the sliding-window fast path.
+    p.add_argument("--rope_theta", type=float, default=None,
+                   help="override the rotary base frequency saved in "
+                        "the checkpoint (e.g. 1e6 for long-context "
+                        "finetunes; default: the checkpoint's value, "
+                        "falling back to 10000)")
+    p.add_argument("--rope_scaling_factor", type=float, default=None,
+                   help="linear RoPE position interpolation: positions "
+                        "divide by this factor before the rotation, "
+                        "stretching a trained context window by ~the "
+                        "factor (pair with a proportionally larger "
+                        "--max_context; default: the checkpoint's "
+                        "value, falling back to 1.0 = off)")
+    p.add_argument("--attention_window_size", type=int, default=None,
+                   help="sliding-window attention for serving: each "
+                        "token attends only the last W positions, the "
+                        "paged kernels skip pages wholly out of window "
+                        "(decode KV traffic O(W) not O(context)) and "
+                        "the engine reclaims out-of-window pages "
+                        "mid-flight (peak pool O(W) per long slot; "
+                        "serve_window_reclaimed_pages on /metrics). "
+                        "Requires --prefill_chunk_tokens > 0. Only "
+                        "sound for models trained/finetuned with a "
+                        "matching window; default: full causal "
+                        "attention")
     p.add_argument("--ttft_slo_s", type=float, default=None,
                    help="SLO-aware admission: reject (HTTP 503 with "
                         "a modeled-drain-time Retry-After) when every "
@@ -220,8 +246,17 @@ def main():
         "num_layers", "hidden_size", "num_attention_heads",
         "num_attention_heads_kv", "ffn_hidden_size", "seq_length",
         "max_position_embeddings", "padded_vocab_size", "rope_theta",
-        "layernorm_epsilon",
+        "rope_scaling_factor", "layernorm_epsilon",
     ) if k in saved}
+    # serve-time RoPE overrides (ISSUE 19): the rotary tables are
+    # computed from the config, not the checkpoint, so retargeting
+    # theta / linear interpolation at load time is sound.
+    if args.rope_theta is not None:
+        common["rope_theta"] = args.rope_theta
+    if args.rope_scaling_factor is not None:
+        common["rope_scaling_factor"] = args.rope_scaling_factor
+    if args.attention_window_size is not None:
+        common["attention_window_size"] = args.attention_window_size
     if args.model == "llama":
         cfg = llama_config(7, vocab_size=saved["padded_vocab_size"], **common)
         model = LlamaModel(cfg)
