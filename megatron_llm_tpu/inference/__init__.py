@@ -21,3 +21,10 @@ from megatron_llm_tpu.inference.router import (  # noqa: F401
     HTTPReplica,
     ReplicaRouter,
 )
+from megatron_llm_tpu.inference.chaos import (  # noqa: F401
+    ChaosFault,
+    ChaosPolicy,
+)
+from megatron_llm_tpu.inference.fleet import (  # noqa: F401
+    FleetController,
+)

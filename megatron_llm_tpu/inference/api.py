@@ -226,7 +226,29 @@ def generate_and_post_process(
     random_seed: int = -1,
 ):
     """Returns (prompts_plus_generations, segments, output_log_probs,
-    tokens) — the reference's return contract (api.py:19-67)."""
+    tokens) — the reference's return contract (api.py:19-67).
+
+    Request contract on a pp>1 mesh (ADVICE r5; docs/GUIDE.md
+    "Serving on a pp>1 mesh"):
+
+    - GREEDY requests (top_k_sampling == 1) may decode through the
+      pipelined stage ring, which is exact-match with the single-mesh
+      path — the route is an internal placement choice with no output
+      effect.
+    - NON-GREEDY sampling is ROUTE-DEPENDENT: the stage ring's
+      per-position RNG fold differs from generate_tokens', so the same
+      `random_seed` would yield different (both individually correct)
+      samples depending on which path served it. Sampled requests
+      therefore never ride the ring — below the reshard limit they
+      decode stage-replicated (seed-stable, matching the single-mesh
+      path); above it they fail loudly rather than silently switch
+      RNG semantics or pay pp x the per-device param memory.
+    - `PP_DECODE_RESHARD_LIMIT_BYTES` (env
+      MEGATRON_TPU_PP_RESHARD_LIMIT_BYTES) is therefore PART OF THE
+      REQUEST CONTRACT, not a tuning knob: it decides which sampled
+      requests a deployment accepts at all. Pin it per deployment;
+      changing it changes which requests succeed, never what any
+      successful request returns."""
     tokens, lengths = tokenize_prompts(
         tokenizer, prompts, tokens_to_generate, add_BOS
     )

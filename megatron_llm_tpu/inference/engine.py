@@ -1299,6 +1299,15 @@ class DecodeEngine:
                 "admission, per request"),
         }
         self._rounds = 0  # did-work scheduler rounds (telemetry clock)
+        # fault-injection hook (ISSUE 20, inference/chaos.py): called at
+        # the top of every scheduler round INSIDE the round's timed
+        # window, so an injected stall rides the round wall the perf
+        # sentinel measures (an honest trip, not a synthetic counter
+        # bump) and an injected raise kills the serve loop through the
+        # REAL poison path (flight-ring dump + _fail_all + _broken).
+        # None (the default) is one attribute check per round — the
+        # chaos-off hot path is unchanged.
+        self._fault_hook = None
         # jax.profiler capture hook (POST /profile): armed request ->
         # started before the next round, stopped after N did-work
         # rounds; start/stop failures are LOGGED no-ops (capture is a
@@ -2072,6 +2081,8 @@ class DecodeEngine:
         window behind `serve_decode_p95_ms`. Returns False when there
         was nothing to do (idle)."""
         t0 = time.perf_counter()
+        if self._fault_hook is not None:
+            self._fault_hook(self)
         self._expire_deadlines()
         did_xfer = self._apply_transfers()
         admitted_before = self._admitted

@@ -192,9 +192,17 @@ class EngineReplica:
     """In-process replica: a `DecodeEngine` (tagged with a replica_id)
     behind the replica protocol the router speaks. The serving tool's
     `--router_replicas`, the scaleout bench, and the router tests all
-    use this form; cross-host fleets use HTTPReplica."""
+    use this form; cross-host fleets use HTTPReplica.
 
-    def __init__(self, engine):
+    `chaos` (ISSUE 20, inference/chaos.py) arms deterministic fault
+    injection: submits eat injected latency and advance the kill
+    trigger, the engine's per-round `_fault_hook` is installed (kills
+    and sentinel-trip stalls fire INSIDE the scheduler round, through
+    the real poison/telemetry paths), and exported hand-off payloads
+    pass through the corruption hook. None (the default) leaves every
+    path bitwise-untouched."""
+
+    def __init__(self, engine, chaos=None):
         if engine.replica_id is None:
             raise ValueError(
                 "a routed engine needs a replica_id (DecodeEngine("
@@ -203,10 +211,15 @@ class EngineReplica:
                 "attributable")
         self.engine = engine
         self.replica_id = engine.replica_id
+        self.chaos = chaos
+        if chaos is not None:
+            engine._fault_hook = chaos.engine_hook(engine.replica_id)
 
     # -- dispatch ----------------------------------------------------------
 
     def submit(self, prompt, tokens_to_generate, **kw):
+        if self.chaos is not None:
+            self.chaos.on_submit(self.replica_id)
         return self.engine.submit(prompt, tokens_to_generate, **kw)
 
     def cancel(self, req):
@@ -215,7 +228,10 @@ class EngineReplica:
     # -- cross-replica KV hand-off (ISSUE 17) ------------------------------
 
     def export_prefix(self, prompt):
-        return self.engine.export_prefix(prompt)
+        payload = self.engine.export_prefix(prompt)
+        if self.chaos is not None:
+            payload = self.chaos.on_export(self.replica_id, payload)
+        return payload
 
     def import_prefix(self, payload):
         return self.engine.import_prefix(payload)
@@ -258,7 +274,19 @@ class EngineReplica:
     def flight_record(self) -> dict:
         return self.engine.flight_record()
 
+    def last_dump_path(self):
+        """The engine's most recent flight-record artifact on disk
+        (poison / sentinel-trip auto-dump), or None — what the router
+        attaches to this replica's eviction event (ISSUE 20)."""
+        return self.engine.recorder.last_dump_path
+
     # -- lifecycle ---------------------------------------------------------
+
+    def warmup(self):
+        """Pre-trace the engine's step executables — the replace cycle
+        warms a replacement BEFORE rotating it in, so the first request
+        it serves never eats a compile stall mid-recovery."""
+        self.engine.warmup()
 
     def start(self):
         if self.engine._thread is None:
@@ -311,7 +339,10 @@ class HTTPReplica:
     def __init__(self, replica_id: int, base_url: str,
                  tokenizer=None, timeout_s: float = 600.0,
                  probe_ttl_s: float = 1.0,
-                 page_size: int = 64, max_context: int = 2048):
+                 probe_timeout_s: float = 5.0,
+                 probe_backoff_cap_s: float = 30.0,
+                 page_size: int = 64, max_context: int = 2048,
+                 chaos=None):
         self.replica_id = replica_id
         self.base_url = base_url.rstrip("/")
         self.tokenizer = tokenizer
@@ -320,6 +351,18 @@ class HTTPReplica:
         self.page_size = page_size
         self.max_context = max_context
         self.num_pages = (max_context * 64) // page_size  # advisory
+        # probe hardening (ISSUE 20 satellite): the probe's socket
+        # timeout is a knob (was a hardcoded 5.0 — a sick host inside
+        # a tighter SLO needs a tighter probe), and consecutive probe
+        # FAILURES back the re-probe off exponentially (probe_ttl_s,
+        # 2x, 4x ... capped at probe_backoff_cap_s) instead of hammering
+        # a flapping replica at full rate; one success resets it. The
+        # current backoff rides the router_reprobe_backoff_s gauge.
+        self.probe_timeout_s = probe_timeout_s
+        self.probe_backoff_cap_s = probe_backoff_cap_s
+        self.chaos = chaos
+        self._fail_streak = 0
+        self._backoff_s = 0.0
         self._probe: Tuple[float, dict] = (0.0, {})
         # histogram scrape cached SEPARATELY from the health/load
         # probe: the probe feeds the ROUTING path (submit-time
@@ -327,13 +370,16 @@ class HTTPReplica:
         # fetch only the fleet /metrics aggregation consumes
         self._hist_probe: Tuple[float, list] = (0.0, [])
 
-    def _get_raw(self, path: str, accept: Optional[str] = None) -> bytes:
+    def _get_raw(self, path: str, accept: Optional[str] = None,
+                 timeout: Optional[float] = None) -> bytes:
         import urllib.request
 
         req = urllib.request.Request(
             self.base_url + path,
             headers={"Accept": accept} if accept else {})
-        with urllib.request.urlopen(req, timeout=5.0) as resp:
+        with urllib.request.urlopen(
+                req, timeout=self.probe_timeout_s
+                if timeout is None else timeout) as resp:
             return resp.read()
 
     def _get_json(self, path: str) -> dict:
@@ -344,12 +390,20 @@ class HTTPReplica:
     def _probed(self) -> dict:
         now = time.monotonic()
         t, snap = self._probe
-        if now - t < self.probe_ttl_s:
+        # a failing replica's snapshot lives probe_ttl_s PLUS the
+        # current exponential backoff — a flapping remote re-probes at
+        # a decaying rate, not the full routing rate
+        if now - t < self.probe_ttl_s + self._backoff_s:
             return snap
         try:
+            if self.chaos is not None \
+                    and self.chaos.on_probe(self.replica_id):
+                raise ConnectionError("chaos: health probe dropped")
             h = self._get_json("/health")
             m = self._get_json("/metrics")
             snap = {"health": h, "metrics": m}
+            self._fail_streak = 0
+            self._backoff_s = 0.0
         except Exception as e:  # noqa: BLE001 — a dead probe IS the signal
             snap = {"health": {"status": "unhealthy",
                                "engine": {"alive": False,
@@ -357,8 +411,18 @@ class HTTPReplica:
                                           "queue_depth": 0,
                                           "slots_busy": 0}},
                     "metrics": {}}
+            self._fail_streak += 1
+            self._backoff_s = min(
+                self.probe_ttl_s * (2 ** (self._fail_streak - 1)),
+                self.probe_backoff_cap_s)
         self._probe = (now, snap)
         return snap
+
+    def reprobe_backoff_s(self) -> float:
+        """The current probe backoff (0.0 while the last probe
+        succeeded) — the router's router_reprobe_backoff_s gauge takes
+        the fleet max of these."""
+        return self._backoff_s
 
     def _scrape_histograms(self) -> list:
         """The remote's latency distributions, rebuilt from its
@@ -589,6 +653,214 @@ class _HandoffRequest:
                              else None)
 
 
+class _RecoverableRequest:
+    """EngineRequest-shaped handle that survives its replica's death
+    (ISSUE 20): the router hands it back instead of the engine's raw
+    request when `recover_requests=True`. If the inner request fails
+    with a replica-death error (serve loop poisoned, engine stopped,
+    an injected chaos kill) BEFORE any token reached the caller, the
+    proxy transparently resubmits the same request through the router
+    — a fresh probe excludes the dead replica — up to `max_resubmits`
+    times. Greedy decoding makes the retry bitwise: the replacement
+    replica regenerates exactly the token stream the dead one would
+    have produced (and sampled requests carry their per-request seed,
+    so they replay identically too).
+
+    What is NOT retried (each documented in docs/GUIDE.md
+    "Self-driving fleet operations"):
+    - PARTIALLY-STREAMED requests: tokens already left the building;
+      a resubmit would re-deliver or reorder them mid-SSE-stream. The
+      proxy fails LOUDLY (the error names the streamed count and tells
+      the client to honour Retry-After) and closes the stream — it
+      never hangs.
+    - deadline-shed (`timed_out`) and cancelled requests: the caller
+      already gave up; resurrecting its request would waste fleet
+      capacity on an abandoned answer.
+    - request-shaped errors (ValueError): every replica refuses them
+      identically.
+
+    Streaming requests pump through a relay thread (the proxy owns the
+    caller-visible stream_q; each inner attempt gets its own), so the
+    SSE layer's contract — every generated token, then one None
+    sentinel — holds across a mid-flight replica swap. Non-streaming
+    requests recover lazily inside result(): no thread, no cost until
+    a replica actually dies."""
+
+    # substrings that identify a REPLICA death (vs a request fault):
+    # the serve-loop poison prefix, engine stop, submit-on-stopped,
+    # and the chaos injector's kill tag
+    _DEATH_MARKERS = ("engine step failed", "engine stopped",
+                      "engine is stopped", "chaos:")
+
+    def __init__(self, router, prompt, tokens_to_generate, kw, inner,
+                 budget: int):
+        self._router = router
+        self._prompt = list(prompt)
+        self._n = int(tokens_to_generate)
+        self._kw = dict(kw)
+        self._inner = inner
+        self._budget = int(budget)
+        self._t_submit0 = getattr(inner, "t_submit", 0.0)
+        self.cancelled = False
+        self.error: Optional[str] = None
+        self.timed_out = False
+        self.done = threading.Event()
+        self._tokens: Optional[list] = None
+        self._log_probs = None
+        self._streamed = 0
+        self.stream_q = None
+        if kw.get("stream"):
+            self.stream_q = queue_mod.SimpleQueue()
+            threading.Thread(target=self._pump, daemon=True).start()
+
+    # -- EngineRequest-shaped surface (SSE id:, router.cancel, bench) ------
+
+    @property
+    def rid(self):
+        return getattr(self._inner, "rid", -1)
+
+    @property
+    def replica_id(self):
+        return getattr(self._inner, "replica_id", None)
+
+    @property
+    def tokens(self):
+        if self._tokens is not None:
+            return self._tokens
+        return getattr(self._inner, "tokens", [])
+
+    @property
+    def log_probs(self):
+        return getattr(self._inner, "log_probs", [])
+
+    @property
+    def return_log_probs(self):
+        return getattr(self._inner, "return_log_probs", False)
+
+    @property
+    def t_submit(self):
+        # the ORIGINAL submit time survives resubmits: TTFT measured on
+        # this handle honestly includes the death + recovery
+        return self._t_submit0
+
+    @property
+    def t_first(self):
+        return getattr(self._inner, "t_first", 0.0)
+
+    @property
+    def t_done(self):
+        return getattr(self._inner, "t_done", 0.0)
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recoverable(self, inner, err: str) -> bool:
+        if self._budget <= 0 or self.cancelled:
+            return False
+        if getattr(inner, "timed_out", False) \
+                or getattr(inner, "cancelled", False):
+            return False
+        return any(m in err for m in self._DEATH_MARKERS)
+
+    def _resubmit(self):
+        """One recovery attempt: redispatch through the router (the
+        fresh probe sees the dead replica's broken health and routes
+        around it). Raises whatever the redispatch raises — a fleet
+        with no healthy replica surfaces as FleetUnavailable, the 503 +
+        Retry-After shape."""
+        self._budget -= 1
+        req = self._router._dispatch_raw(self._prompt, self._n,
+                                         dict(self._kw))
+        with self._router._lock:
+            self._router._resubmitted += 1
+        _logger.warning(
+            "router: request resubmitted to replica %s after replica "
+            "death (%d retr%s left)", getattr(req, "replica_id", None),
+            self._budget, "y" if self._budget == 1 else "ies")
+        self._inner = req
+        return req
+
+    def result(self, timeout: Optional[float] = None):
+        if self.stream_q is not None:
+            # streaming: the pump thread owns recovery and the final
+            # outcome — result() just reports it
+            if not self.done.wait(timeout):
+                raise TimeoutError("request still running")
+            if self.error is not None:
+                if self.timed_out:
+                    raise TimeoutError(self.error)
+                raise RuntimeError(self.error)
+            return self._tokens, self._log_probs
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            inner = self._inner
+            left = (None if deadline is None
+                    else max(deadline - time.monotonic(), 0.0))
+            try:
+                out = inner.result(left)
+            except TimeoutError:
+                # either our wait budget ran out or the request was
+                # deadline-shed — neither is retried
+                self.timed_out = getattr(inner, "timed_out", False)
+                self.error = getattr(inner, "error", None)
+                raise
+            except RuntimeError as e:
+                if not self._recoverable(inner, str(e)):
+                    self.error = str(e)
+                    self.done.set()
+                    raise
+                self._resubmit()  # raises FleetUnavailable when the
+                continue          # whole fleet is gone (a 503, not a hang)
+            self._tokens, self._log_probs = out
+            self.done.set()
+            return out
+
+    def _pump(self):
+        """Streaming relay: forward each inner attempt's tokens onto
+        the caller's stream; on a pre-stream replica death, resubmit
+        and keep pumping; on any terminal outcome, mirror it and close
+        the stream with the one None sentinel."""
+        while True:
+            inner = self._inner
+            q = getattr(inner, "stream_q", None)
+            while True:
+                tok = q.get()  # the engine ALWAYS closes with None
+                if tok is None:
+                    break
+                self._streamed += 1
+                self.stream_q.put(tok)
+            # sentinel seen: error/done were set before _finish()
+            err = getattr(inner, "error", None)
+            if err is None:
+                self._tokens = list(getattr(inner, "tokens", []) or [])
+                self._log_probs = (list(inner.log_probs)
+                                   if getattr(inner, "return_log_probs",
+                                              False) else None)
+                self.done.set()
+                self.stream_q.put(None)
+                return
+            if self._streamed == 0 and self._recoverable(inner, err):
+                try:
+                    self._resubmit()
+                    continue
+                except BaseException as e:  # noqa: BLE001 — surfaced
+                    err = (f"resubmit after replica death failed: "
+                           f"{e!r} (original death: {err})")
+            elif self._streamed > 0 and any(
+                    m in err for m in self._DEATH_MARKERS):
+                err = (f"replica died after {self._streamed} token(s) "
+                       f"already streamed: {err} — partially-streamed "
+                       f"requests are never resubmitted (a retry would "
+                       f"re-deliver tokens the client already has); "
+                       f"stream closed, retry the request after the "
+                       f"Retry-After interval")
+            self.error = err
+            self.timed_out = getattr(inner, "timed_out", False)
+            self.done.set()
+            self.stream_q.put(None)
+            return
+
+
 class ReplicaRouter:
     """Prefix-affinity dispatcher over N replicas (module docstring).
 
@@ -628,7 +900,9 @@ class ReplicaRouter:
                  decode_replicas: Optional[List] = None,
                  disagg_min_prompt_pages: int = 2,
                  ttft_slo_s: Optional[float] = None,
-                 handoff_timeout_s: float = 600.0):
+                 handoff_timeout_s: float = 600.0,
+                 recover_requests: bool = False,
+                 max_resubmits: int = 2):
         if (prefill_replicas is None) != (decode_replicas is None):
             raise ValueError(
                 "disaggregated mode takes BOTH prefill_replicas= and "
@@ -701,6 +975,24 @@ class ReplicaRouter:
         # choice alongside the modeled backlogs it was made from)
         self._decisions: collections.deque = collections.deque(
             maxlen=256)
+        # ISSUE 20: in-flight recovery + self-driving fleet state.
+        # `recover_requests` wraps every direct-path handle in a
+        # _RecoverableRequest; everything below is gated on it (or on
+        # a FleetController registering via _managed) so the
+        # unmanaged router's /metrics and flight_record stay
+        # byte-identical to the legacy schema.
+        self.recover_requests = bool(recover_requests)
+        self.max_resubmits = int(max_resubmits)
+        self._resubmitted = 0
+        self._fleet_replaced = 0
+        self._scale_events = 0
+        self._handoff_rejected = 0
+        self._managed = False      # a FleetController owns this fleet
+        self._controller = None
+        # eviction trail: every replica that left rotation, with the
+        # flight-record dump it left behind (ROADMAP 5a correlation)
+        self._evictions: collections.deque = collections.deque(
+            maxlen=64)
 
     # -- health ------------------------------------------------------------
 
@@ -739,16 +1031,141 @@ class ReplicaRouter:
                 self._mark_down(rid, h["broken"] or "serve loop dead")
         return healthy, loads, mloads
 
-    def _mark_down(self, rid: int, why) -> None:
-        """Takes the router lock itself — callers must NOT hold it."""
+    def _mark_down(self, rid: int, why,
+                   cooldown: Optional[float] = None) -> None:
+        """Takes the router lock itself — callers must NOT hold it.
+        Every departure appends an eviction event carrying the
+        replica's last flight-record dump path (ISSUE 20 / ROADMAP 5a:
+        poison rotation and the engine's auto-dump used to be
+        uncorrelated artifacts)."""
+        rep = self._by_id.get(rid)
+        dump = None
+        if rep is not None:
+            fn = getattr(rep, "last_dump_path", None)
+            if fn is not None:
+                try:
+                    dump = fn()
+                except Exception:  # noqa: BLE001 — advisory attach
+                    dump = None
+        cd = self.unhealthy_cooldown_s if cooldown is None else cooldown
         with self._lock:
             dropped = self._index.drop_replica(rid)
-            self._down_until[rid] = (time.monotonic()
-                                     + self.unhealthy_cooldown_s)
+            self._down_until[rid] = time.monotonic() + cd
+            self._evictions.append({
+                "t": time.time(), "replica": rid,
+                "why": str(why)[:200], "index_dropped": dropped,
+                "flight_dump": dump})
         _logger.warning(
             "router: replica %d out of rotation (%s); %d affinity "
             "entries dropped (its pools died with it), re-probe in "
-            "%.1fs", rid, why, dropped, self.unhealthy_cooldown_s)
+            "%.1fs%s", rid, why, dropped, cd,
+            f", flight record at {dump}" if dump else "")
+
+    # -- fleet mutation (ISSUE 20: the FleetController's surface) ----------
+
+    def condemn(self, rid: int, why: str = "condemned") -> None:
+        """Take a replica out of rotation PERMANENTLY (infinite
+        cooldown): the health re-probe can never readmit it. The
+        controller's replace cycle condemns first — stopping admission
+        — then drains, stops, and swaps in the replacement via
+        replace_replica() (which clears the condemnation)."""
+        self._mark_down(rid, why, cooldown=float("inf"))
+
+    def replace_replica(self, rid: int, new_rep) -> None:
+        """Swap a (condemned/dead) replica for a warmed replacement
+        carrying the SAME replica id — the rotation-back-in step of
+        the replace cycle. The replacement's pools start empty, so its
+        affinity-index entries (already dropped at condemn time) stay
+        dropped."""
+        if new_rep.replica_id != rid:
+            raise ValueError(
+                f"replacement carries replica_id "
+                f"{new_rep.replica_id}, expected {rid} — the fleet's "
+                f"id space (dispatch accounting, SSE replica tags) "
+                f"must stay stable across a replace")
+        if new_rep.page_size != self.page_size:
+            raise ValueError(
+                f"replacement page_size {new_rep.page_size} != fleet "
+                f"page_size {self.page_size}")
+        with self._lock:
+            if rid not in self._by_id:
+                raise KeyError(f"no replica {rid} in this fleet")
+            # rebuild as a NEW list: _probe/counters/health iterate
+            # self.replicas unlocked, and must see either the old or
+            # the new fleet, never a half-mutated one
+            self.replicas = [new_rep if r.replica_id == rid else r
+                             for r in self.replicas]
+            self._by_id[rid] = new_rep
+            self._index.drop_replica(rid)
+            self._down_until.pop(rid, None)  # lift the condemnation
+        _logger.warning("router: replica %d replaced, back in "
+                        "rotation", rid)
+
+    def add_replica(self, rep) -> None:
+        """Grow the active set (scale-up). Symmetric fleets only: a
+        disaggregated fleet's role lists are a topology decision the
+        controller does not make."""
+        if self.disagg:
+            raise ValueError("add_replica: disaggregated fleets do "
+                             "not support elastic scaling")
+        if rep.page_size != self.page_size:
+            raise ValueError(
+                f"new replica page_size {rep.page_size} != fleet "
+                f"page_size {self.page_size}")
+        with self._lock:
+            if rep.replica_id in self._by_id:
+                raise ValueError(
+                    f"duplicate replica id {rep.replica_id}")
+            self.replicas = self.replicas + [rep]
+            self._by_id[rep.replica_id] = rep
+            self._decode_ids = self._decode_ids + [rep.replica_id]
+            self._per_replica.setdefault(rep.replica_id, 0)
+            self.max_context = min(r.max_context for r in self.replicas)
+            self.num_pages = min(r.num_pages for r in self.replicas)
+        _logger.warning("router: replica %d added (fleet now %d)",
+                        rep.replica_id, len(self.replicas))
+
+    def remove_replica(self, rid: int):
+        """Shrink the active set (scale-down): drop the replica from
+        rotation and RETURN it — the caller owns the drain + stop (the
+        controller drains it outside the router lock). Refuses to
+        remove the last replica: an empty fleet cannot 503 its way
+        back."""
+        if self.disagg:
+            raise ValueError("remove_replica: disaggregated fleets do "
+                             "not support elastic scaling")
+        with self._lock:
+            if rid not in self._by_id:
+                raise KeyError(f"no replica {rid} in this fleet")
+            if len(self.replicas) <= 1:
+                raise ValueError("remove_replica: refusing to remove "
+                                 "the last replica")
+            rep = self._by_id.pop(rid)
+            self.replicas = [r for r in self.replicas
+                             if r.replica_id != rid]
+            self._decode_ids = [r for r in self._decode_ids
+                                if r != rid]
+            self._index.drop_replica(rid)
+            self._down_until.pop(rid, None)
+            self.max_context = min(r.max_context for r in self.replicas)
+            self.num_pages = min(r.num_pages for r in self.replicas)
+        _logger.warning("router: replica %d removed (fleet now %d)",
+                        rid, len(self.replicas))
+        return rep
+
+    def note_replaced(self) -> None:
+        with self._lock:
+            self._fleet_replaced += 1
+
+    def note_scale_event(self) -> None:
+        with self._lock:
+            self._scale_events += 1
+
+    def evictions(self) -> list:
+        """The bounded eviction trail (replica departures with their
+        flight-record dump paths)."""
+        with self._lock:
+            return [dict(e) for e in self._evictions]
 
     # -- dispatch ----------------------------------------------------------
 
@@ -831,7 +1248,20 @@ class ReplicaRouter:
         Raises the last replica error — QueueFull only when EVERY
         healthy replica's queue is full, FleetUnavailable (a QueueFull:
         the HTTP layer's 503 + Retry-After) when no replica is healthy
-        at all, BacklogExceeded when modeled admission rejects."""
+        at all, BacklogExceeded when modeled admission rejects.
+
+        With `recover_requests=True` (symmetric fleets) the returned
+        handle is a _RecoverableRequest: if its replica dies before any
+        token streamed, the handle transparently redispatches through
+        this router (ISSUE 20 in-flight recovery)."""
+        if not self.disagg:
+            prompt = list(prompt)
+            req = self._dispatch_raw(prompt, tokens_to_generate, kw)
+            if self.recover_requests:
+                return _RecoverableRequest(self, prompt,
+                                           tokens_to_generate, kw, req,
+                                           self.max_resubmits)
+            return req
         healthy, loads, mloads = self._probe()  # blocking I/O unlocked
         if not healthy:
             with self._lock:
@@ -841,10 +1271,6 @@ class ReplicaRouter:
                 "or cooling down) — the fleet cannot take traffic; "
                 "retry after the cooldown")
         prompt = list(prompt)
-        if not self.disagg:
-            self._admission_gate(healthy)
-            return self._submit_direct(prompt, tokens_to_generate, kw,
-                                       healthy, loads, mloads)
         pre = [r for r in self._prefill_ids if r in healthy]
         # short prompts stay on decode replicas; with every decode
         # replica down the fleet degrades to whatever is healthy
@@ -861,6 +1287,23 @@ class ReplicaRouter:
                                           kw)
         return self._submit_direct(prompt, tokens_to_generate, kw,
                                    dec, loads, mloads)
+
+    def _dispatch_raw(self, prompt, tokens_to_generate, kw):
+        """One symmetric-fleet dispatch attempt: probe, admission
+        gate, direct submit. Split out of submit() so the recovery
+        proxy can redispatch a dead replica's request through a FRESH
+        probe (which sees the death and routes around it)."""
+        healthy, loads, mloads = self._probe()  # blocking I/O unlocked
+        if not healthy:
+            with self._lock:
+                self._rejected += 1
+            raise FleetUnavailable(
+                "router: no healthy replica (all poisoned/stopped "
+                "or cooling down) — the fleet cannot take traffic; "
+                "retry after the cooldown")
+        self._admission_gate(healthy)
+        return self._submit_direct(prompt, tokens_to_generate, kw,
+                                   healthy, loads, mloads)
 
     def _submit_direct(self, prompt, tokens_to_generate, kw,
                        cands: List[int], loads, mloads):
@@ -988,7 +1431,21 @@ class ReplicaRouter:
             moved = 0
             try:
                 if payload is not None and not proxy.cancelled:
-                    res = rep.import_prefix(payload)
+                    try:
+                        res = rep.import_prefix(payload)
+                    except ValueError as e:
+                        # corrupt/mismatched payload (ISSUE 20 chaos
+                        # matrix): the receiver's geometry gate refused
+                        # the splice. Degrade, don't fail — drop the
+                        # payload and let the decode replica prefill
+                        # the prompt itself (correct, only slower).
+                        _logger.warning(
+                            "router: decode replica %d rejected the "
+                            "hand-off payload (%s) — degrading to a "
+                            "local prefill", rid, e)
+                        with self._lock:
+                            self._handoff_rejected += 1
+                        res, payload = False, None
                     if res:
                         moved = int(res.get("pages", 0))
                 req = rep.submit(prompt, tokens_to_generate, **kw)
@@ -1058,6 +1515,9 @@ class ReplicaRouter:
         proxy.finalize(req)
 
     def cancel(self, req) -> None:
+        if isinstance(req, _RecoverableRequest):
+            req.cancelled = True  # stops any further resubmit
+            req = req._inner      # fall through: cancel the live inner
         if isinstance(req, _HandoffRequest):
             req.cancelled = True  # pre-attach: the orchestration
             # thread sees it and cancels on arrival
@@ -1074,6 +1534,17 @@ class ReplicaRouter:
     # -- aggregated observability -----------------------------------------
 
     def router_stats(self) -> dict:
+        # probe-backoff gauge reads replica state OUTSIDE the lock
+        # (HTTPReplica accessors are plain attribute reads, but the
+        # replica list itself may be mid-scale — snapshot it)
+        backoff = 0.0
+        for rep in list(self.replicas):
+            fn = getattr(rep, "reprobe_backoff_s", None)
+            if fn is not None:
+                try:
+                    backoff = max(backoff, float(fn()))
+                except Exception:  # noqa: BLE001 — advisory gauge
+                    pass
         with self._lock:
             d = max(self._dispatches, 1)
             out = {
@@ -1100,6 +1571,17 @@ class ReplicaRouter:
                 out["serve_transfer_ms"] = round(self._transfer_ms, 2)
             if self.ttft_slo_s is not None:
                 out["router_slo_rejected"] = self._slo_rejected
+            # ISSUE 20: each gated on ITS feature being armed so the
+            # unmanaged, non-recovering fleet keeps the legacy schema
+            if self.recover_requests:
+                out["serve_resubmitted"] = self._resubmitted
+            if self._managed:
+                out["serve_fleet_replaced"] = self._fleet_replaced
+                out["serve_scale_events"] = self._scale_events
+            if self._handoff_rejected:
+                out["serve_handoff_rejected"] = self._handoff_rejected
+            if backoff > 0:
+                out["router_reprobe_backoff_s"] = round(backoff, 3)
             return out
 
     def decision_log(self) -> list:
@@ -1210,6 +1692,13 @@ class ReplicaRouter:
         if self.disagg or self.ttft_slo_s is not None:
             # gated like the counters: pre-ISSUE-17 dumps keep their shape
             out["decisions"] = self.decision_log()
+        # ISSUE 20: gated on having something to report — a fleet that
+        # never lost a replica (and runs unmanaged) keeps legacy shape
+        ev = self.evictions()
+        if ev:
+            out["evictions"] = ev
+        if self._controller is not None:
+            out["fleet"] = self._controller.flight_events()
         return out
 
     def request_profile(self, rounds: int,

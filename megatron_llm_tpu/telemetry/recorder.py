@@ -63,6 +63,11 @@ class FlightRecorder:
         self._pid = os.getpid()
         self.dropped = 0
         self.dumps = 0
+        # the most recent artifact dump() actually wrote (ISSUE 20):
+        # the router attaches it to the eviction event when a replica
+        # leaves rotation, so poison rotation and the auto-dump stop
+        # being uncorrelated; stays None until a dump lands on disk
+        self.last_dump_path: Optional[str] = None
 
     # -- emit (GR006 HOT_PATHS: host bookkeeping only) ---------------------
 
@@ -142,4 +147,5 @@ class FlightRecorder:
         _logger.error(
             "FLIGHT RECORDER (%s): dumped %d events + counters to %s",
             reason, len(snap["events"]), path)
+        self.last_dump_path = path
         return path

@@ -304,6 +304,23 @@ def _bench_diff(artifact_path, baseline_path):
         "artifact": artifact_path,
         "max_drop": BENCH_HEADLINE_MAX_DROP,
     }
+    # ISSUE 20: the self-driving-fleet acceptance headlines ride the
+    # artifact under extra.serving.autonomy — when present, the
+    # zero-failed-request bar and the bitwise-resubmit pin become
+    # their own gate (absent on legacy artifacts -> unarmed)
+    auto = ((art.get("extra") or {}).get("serving") or {}).get(
+        "autonomy")
+    if auto is not None:
+        failed = auto.get("failed_requests")
+        bitwise = auto.get("bitwise_resubmits_match")
+        out["autonomy"] = {
+            "failed_requests": failed,
+            "bitwise_resubmits_match": bitwise,
+            "recovery_s": auto.get("recovery_s"),
+            "convergence_tok_s_ratio": auto.get(
+                "convergence_tok_s_ratio"),
+            "ok": failed == 0 and bool(bitwise),
+        }
     if not baseline_path:
         out |= {"ok": None,
                 "note": "no --bench-baseline: headline recorded, "
@@ -376,6 +393,18 @@ def build_verdict(report, bench=None) -> dict:
                     f"baseline {bench.get('baseline_value')} "
                     f"(ratio {bench.get('headline_ratio')}, floor "
                     f"{1.0 - BENCH_HEADLINE_MAX_DROP})")
+        auto = bench.get("autonomy")
+        if auto is not None:
+            # ISSUE 20: the chaos-convergence headlines gate on their
+            # own — a run that failed requests (or whose resubmits
+            # were not bitwise) is a NO-GO regardless of tok/s
+            gates["bench_autonomy"] = bool(auto["ok"])
+            if not auto["ok"]:
+                reasons.append(
+                    f"autonomy: {auto.get('failed_requests')} failed "
+                    f"request(s), bitwise_resubmits_match="
+                    f"{auto.get('bitwise_resubmits_match')} (the "
+                    f"zero-failed-request convergence bar)")
     ok = all(gates.values())
     return {
         "verdict": "GO" if ok else "NO-GO",

@@ -219,6 +219,49 @@ def main():
                         "this many seconds of device time (needs "
                         "--cost_registry + --chip_spec on the "
                         "engines; without them the gate stays open)")
+    # ISSUE 20 self-driving fleet (docs/GUIDE.md "Self-driving fleet
+    # operations"): fault injection, sentinel-driven replace cycles,
+    # in-flight request recovery, load-adaptive scaling.
+    p.add_argument("--chaos", type=str, default=None,
+                   help="deterministic fault injection (inference/"
+                        "chaos.py grammar), e.g. "
+                        "'kill=1@8,probe_drop=0.3,seed=7': kill=RID[@N]"
+                        " poisons replica RID after N submits, "
+                        "stall=RID:MSxK trips the sentinel, probe_drop"
+                        "/probe_latency_ms/submit_latency_ms degrade "
+                        "the control plane, corrupt_handoff exercises "
+                        "the KV hand-off geometry gate. TEST KNOB — "
+                        "never arm in production")
+    p.add_argument("--fleet_controller", action="store_true",
+                   help="run the FleetController (inference/fleet.py)"
+                        ": condemned/poisoned/sentinel-tripped "
+                        "replicas are drained, stopped, rebuilt on "
+                        "their devices, warmed and rotated back in; "
+                        "scale decisions (with --scale_up_backlog_s/"
+                        "--scale_down_backlog_s) and replace cycles "
+                        "land in the flight record. Needs "
+                        "--router_replicas > 1")
+    p.add_argument("--recover_requests",
+                   action=argparse.BooleanOptionalAction, default=None,
+                   help="transparently resubmit queued and not-yet-"
+                        "streamed requests of a dead replica to a "
+                        "healthy one (greedy retries are bitwise; "
+                        "partially-streamed requests fail loudly with "
+                        "Retry-After instead). Default: on when "
+                        "--fleet_controller is set, off otherwise")
+    p.add_argument("--scale_up_backlog_s", type=float, default=None,
+                   help="fleet controller scale-up threshold: grow "
+                        "the active set when per-replica modeled "
+                        "backlog exceeds this many seconds (needs "
+                        "--cost_registry + --chip_spec)")
+    p.add_argument("--scale_down_backlog_s", type=float, default=None,
+                   help="fleet controller scale-down threshold: "
+                        "shrink when per-replica modeled backlog "
+                        "falls below this (keep a wide dead band "
+                        "under --scale_up_backlog_s)")
+    p.add_argument("--scale_patience", type=int, default=3,
+                   help="consecutive identical scale verdicts before "
+                        "the controller acts (flap hysteresis)")
     args = p.parse_args()
 
     import jax
@@ -322,6 +365,22 @@ def main():
                 perf_sentinel_patience=args.perf_sentinel_patience,
             )
 
+        chaos = None
+        if args.chaos:
+            from megatron_llm_tpu.inference.chaos import ChaosPolicy
+
+            if n_rep <= 1:
+                raise SystemExit(
+                    "--chaos needs --router_replicas > 1 (faults "
+                    "target replicas; a one-engine deployment has "
+                    "nothing to fail over to)")
+            chaos = ChaosPolicy.parse(args.chaos)
+        if args.fleet_controller and n_rep <= 1:
+            raise SystemExit(
+                "--fleet_controller needs --router_replicas > 1")
+        recover = (args.recover_requests
+                   if args.recover_requests is not None
+                   else args.fleet_controller)
         if n_rep > 1:
             # N replicas behind the prefix-affinity router: replica i
             # owns the device block [i*tp, (i+1)*tp)
@@ -333,7 +392,8 @@ def main():
             replicas = [
                 EngineReplica(build_engine(
                     replica_id=i,
-                    devices=jax.devices()[i * tp:(i + 1) * tp]))
+                    devices=jax.devices()[i * tp:(i + 1) * tp]),
+                    chaos=chaos)
                 for i in range(n_rep)
             ]
             n_pre = args.prefill_replicas
@@ -351,7 +411,28 @@ def main():
             else:
                 engine = ReplicaRouter(replicas,
                                        affinity=args.affinity_routing,
-                                       ttft_slo_s=args.ttft_slo_s)
+                                       ttft_slo_s=args.ttft_slo_s,
+                                       recover_requests=recover)
+            if args.fleet_controller:
+                from megatron_llm_tpu.inference.fleet import (
+                    FleetController,
+                )
+
+                # replacements rebuild on the dead replica's device
+                # block, WITHOUT the chaos policy: an injected kill
+                # must not re-fire on the replacement forever
+                def spawn_replica(old, _tp=tp):
+                    rid = old.replica_id
+                    return EngineReplica(build_engine(
+                        replica_id=rid,
+                        devices=jax.devices()[rid * _tp:
+                                              (rid + 1) * _tp]))
+
+                FleetController(
+                    engine, spawn_replica=spawn_replica,
+                    scale_up_backlog_s=args.scale_up_backlog_s,
+                    scale_down_backlog_s=args.scale_down_backlog_s,
+                    scale_patience=args.scale_patience).start()
         else:
             if args.prefill_replicas:
                 raise SystemExit(
@@ -374,6 +455,9 @@ def main():
                  f"{'ON' if args.affinity_routing else 'OFF'}"
                  + (f", ttft_slo {args.ttft_slo_s}s"
                     if args.ttft_slo_s is not None else "")
+                 + (", fleet controller" if args.fleet_controller
+                    else "")
+                 + (f", CHAOS[{args.chaos}]" if args.chaos else "")
                  + "), ")
     elif engine is not None and engine.serving_tp > 1:
         fleet = f"tp{engine.serving_tp} mesh, "
